@@ -1,0 +1,68 @@
+"""kafkastreams_cep_tpu: a TPU-native complex event processing framework.
+
+A ground-up re-design of the capabilities of the `kafkastreams-cep` reference
+library (see SURVEY.md): a fluent pattern-query DSL, a SASE NFA^b compiler
+with strict-contiguity / skip-till-next-match / skip-till-any-match selection
+strategies, Dewey-versioned simultaneous runs over a shared versioned buffer,
+fold aggregates, time windows, and a streaming runtime with
+checkpoint/resume -- with the per-event hot loop re-architected as
+vmapped, jit-compiled JAX kernels over HBM-resident structure-of-arrays
+state (ops/), sharded across device meshes (parallel/).
+"""
+
+from .core.dewey import DeweyVersion
+from .core.event import Event
+from .core.sequence import Sequence, SequenceBuilder, Staged
+from .pattern.builder import QueryBuilder
+from .pattern.compiler import InvalidPatternException, compile_pattern
+from .pattern.expressions import agg, const, field, key, timestamp, topic_is, value
+from .pattern.pattern import Pattern, Selected, Strategy
+from .pattern.stages import EdgeOperation, Stage, Stages, StateType
+from .nfa.nfa import NFA, ComputationStage, initial_computation_stage
+from .state.aggregates import AggregatesStore, States, UnknownAggregateException
+from .state.buffer import Matched, SharedVersionedBuffer
+from .state.nfa_store import NFAStates, NFAStore
+from .streams.builder import ComplexStreamsBuilder
+from .streams.processor import CEPProcessor
+from .streams.serde import Queried, sequence_to_json
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DeweyVersion",
+    "Event",
+    "Sequence",
+    "SequenceBuilder",
+    "Staged",
+    "QueryBuilder",
+    "InvalidPatternException",
+    "compile_pattern",
+    "agg",
+    "const",
+    "field",
+    "key",
+    "timestamp",
+    "topic_is",
+    "value",
+    "Pattern",
+    "Selected",
+    "Strategy",
+    "EdgeOperation",
+    "Stage",
+    "Stages",
+    "StateType",
+    "NFA",
+    "ComputationStage",
+    "initial_computation_stage",
+    "AggregatesStore",
+    "States",
+    "UnknownAggregateException",
+    "Matched",
+    "SharedVersionedBuffer",
+    "NFAStates",
+    "NFAStore",
+    "ComplexStreamsBuilder",
+    "CEPProcessor",
+    "Queried",
+    "sequence_to_json",
+]

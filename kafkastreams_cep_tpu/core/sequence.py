@@ -1,0 +1,119 @@
+"""A completed pattern match: ordered per-stage event sets.
+
+Re-design of the reference's match result object
+(reference: core/.../cep/Sequence.java:36-225): a `Sequence` is an ordered
+collection of `Staged` groups (stage name -> sorted event set), assembled in
+reverse while walking the shared versioned buffer backwards from the final
+event. On the device path, sequences are decoded from compact
+(stage-id, event-slot) match descriptors emitted by the kernel.
+"""
+from __future__ import annotations
+
+from typing import Dict, Generic, Iterator, List, Optional, Tuple, TypeVar
+
+from .event import Event
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class Staged(Generic[K, V]):
+    """Events matched by a single stage, kept in stream order."""
+
+    __slots__ = ("stage", "_events")
+
+    def __init__(self, stage: str, events: Optional[List[Event[K, V]]] = None) -> None:
+        self.stage = stage
+        self._events: List[Event[K, V]] = sorted(set(events or []))
+
+    def add(self, event: Event[K, V]) -> None:
+        if event not in self._events:
+            self._events.append(event)
+            self._events.sort()
+
+    @property
+    def events(self) -> Tuple[Event[K, V], ...]:
+        return tuple(self._events)
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Staged):
+            return NotImplemented
+        return self.stage == other.stage and self._events == other._events
+
+    def __hash__(self) -> int:
+        return hash((self.stage, tuple(self._events)))
+
+    def __repr__(self) -> str:
+        return f"{{stage={self.stage!r}, events={self._events!r}}}"
+
+
+class Sequence(Generic[K, V]):
+    """An ordered collection of per-stage matched event groups."""
+
+    def __init__(self, matched: List[Staged[K, V]]) -> None:
+        self.matched: List[Staged[K, V]] = list(matched)
+        self._by_name: Dict[str, Staged[K, V]] = {s.stage: s for s in self.matched}
+
+    def get_by_name(self, stage: str) -> Optional[Staged[K, V]]:
+        return self._by_name.get(stage)
+
+    def get_by_index(self, index: int) -> Staged[K, V]:
+        return self.matched[index]
+
+    def size(self) -> int:
+        return sum(len(s.events) for s in self.matched)
+
+    def __len__(self) -> int:
+        return self.size()
+
+    def __iter__(self) -> Iterator[Event[K, V]]:
+        for staged in self.matched:
+            yield from staged.events
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Sequence):
+            return NotImplemented
+        return self.matched == other.matched
+
+    def __hash__(self) -> int:
+        return hash(tuple(self.matched))
+
+    def __repr__(self) -> str:
+        return repr(self.matched)
+
+    def to_dict(self) -> dict:
+        """JSON-friendly form used by the egress serde (streams/serde.py)."""
+        return {
+            "events": [
+                {
+                    "name": staged.stage,
+                    "events": [e.value for e in staged.events],
+                }
+                for staged in self.matched
+            ]
+        }
+
+    @staticmethod
+    def builder() -> "SequenceBuilder[K, V]":
+        return SequenceBuilder()
+
+
+class SequenceBuilder(Generic[K, V]):
+    """Accumulates (stage, event) pairs preserving first-insertion stage order."""
+
+    def __init__(self) -> None:
+        self._matched: Dict[str, Staged[K, V]] = {}
+
+    def add(self, stage: str, event: Event[K, V]) -> "SequenceBuilder[K, V]":
+        staged = self._matched.get(stage)
+        if staged is None:
+            staged = Staged(stage)
+            self._matched[stage] = staged
+        staged.add(event)
+        return self
+
+    def build(self, reversed_: bool = False) -> Sequence[K, V]:
+        groups = list(self._matched.values())
+        if reversed_:
+            groups = groups[::-1]
+        return Sequence(groups)

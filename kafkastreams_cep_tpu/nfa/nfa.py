@@ -1,0 +1,365 @@
+"""Host NFA runtime: the per-record match loop.
+
+This is the behavioral oracle for the TPU engine: a faithful re-implementation
+of the reference SASE NFA^b evaluator
+(reference: core/.../cep/nfa/NFA.java:134-397, ComputationStage.java:30-185).
+Per event it drains the run queue once, evaluates each live run against the
+compiled stage graph (recursively descending epsilon PROCEED chains), applies
+the edge operations:
+
+  * PROCEED/SKIP_PROCEED: epsilon descent, extending the Dewey version with a
+    new stage digit when genuinely crossing to the next stage;
+  * TAKE: consume on a self loop, re-adding the run, buffer put with a
+    branch-aware version (NFA.java:238-255);
+  * BEGIN: consume and forward via a synthesized epsilon state
+    (NFA.java:256-271);
+  * IGNORE: re-add the run unchanged (NFA.java:272-285);
+
+branches a run when one event matches >=2 edge combinations
+(PROCEED+TAKE / IGNORE+TAKE / IGNORE+BEGIN / IGNORE+PROCEED,
+NFA.java:392-397) -- cloning the run with a bumped Dewey number (addRun(2)
+from a begin state), duplicating fold registers and incrementing buffer
+refcounts -- and always re-adds the begin state so new matches can start
+(NFA.java:323-338). Matches are extracted from the shared buffer when a run
+forwards to the final state.
+
+The TPU engine (ops/engine.py) implements the same transition relation as a
+vmapped kernel over fixed-capacity run lanes with the epsilon descent
+unrolled at query-compile time; this interpreter defines its conformance
+contract.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Generic, List, Optional, Set, TypeVar
+
+from ..core.dewey import DeweyVersion
+from ..core.event import Event
+from ..core.sequence import Sequence
+from ..pattern.stages import Edge, EdgeOperation, Stage, Stages
+from ..state.aggregates import AggregatesStore, States
+from ..state.buffer import Matched, ReadOnlySharedVersionBuffer, SharedVersionedBuffer
+from .context import FoldEnv, MatcherContext
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+@dataclass(frozen=True)
+class ComputationStage(Generic[K, V]):
+    """One live NFA run (ComputationStage.java:30-185)."""
+
+    stage: Stage
+    version: DeweyVersion
+    sequence: int
+    last_event: Optional[Event[K, V]] = None
+    timestamp: int = -1
+    is_branching: bool = False
+    is_ignored: bool = False
+
+    def with_version(self, version: DeweyVersion) -> "ComputationStage[K, V]":
+        # Mirrors ComputationStage.setVersion: branching/ignored flags reset.
+        return ComputationStage(self.stage, version, self.sequence, self.last_event, self.timestamp)
+
+    @property
+    def is_begin_state(self) -> bool:
+        return self.stage.is_begin
+
+    def is_out_of_window(self, time: int) -> bool:
+        return self.stage.window_ms != -1 and (time - self.timestamp) > self.stage.window_ms
+
+    @property
+    def is_forwarding(self) -> bool:
+        edges = self.stage.edges
+        return len(edges) == 1 and edges[0].operation == EdgeOperation.PROCEED
+
+    @property
+    def is_forwarding_to_final(self) -> bool:
+        return self.is_forwarding and self.stage.edges[0].target.is_final
+
+
+def initial_computation_stage(stages: Stages) -> ComputationStage:
+    return ComputationStage(stage=stages.begin_stage(), version=DeweyVersion(1), sequence=1)
+
+
+class NFA(Generic[K, V]):
+    """Non-deterministic finite automaton over a shared versioned buffer."""
+
+    def __init__(
+        self,
+        aggregates_store: AggregatesStore,
+        buffer: SharedVersionedBuffer[K, V],
+        aggregates_names: Set[str],
+        computation_stages: List[ComputationStage[K, V]],
+        runs: int = 1,
+    ) -> None:
+        self.aggregates_store = aggregates_store
+        self.buffer = buffer
+        self.aggregates_names = set(aggregates_names)
+        self.computation_stages: List[ComputationStage[K, V]] = list(computation_stages)
+        self.runs = runs
+
+    @staticmethod
+    def build(
+        stages: Stages,
+        aggregates_store: AggregatesStore,
+        buffer: SharedVersionedBuffer,
+    ) -> "NFA":
+        return NFA(
+            aggregates_store,
+            buffer,
+            stages.defined_states(),
+            [initial_computation_stage(stages)],
+        )
+
+    # ------------------------------------------------------------------ API
+    def match_pattern(self, event: Event[K, V]) -> List[Sequence[K, V]]:
+        """Process one event; returns completed matches in emission order."""
+        to_process = len(self.computation_stages)
+        final_states: List[ComputationStage[K, V]] = []
+
+        while to_process > 0:
+            to_process -= 1
+            computation = self.computation_stages.pop(0)
+            states = self._match_computation(computation, event)
+            if not states:
+                self._remove_pattern(computation)
+            else:
+                final_states.extend(s for s in states if s.is_forwarding_to_final)
+            self.computation_stages.extend(s for s in states if not s.is_forwarding_to_final)
+
+        return self._match_construction(final_states)
+
+    # ------------------------------------------------------------ internals
+    def _match_construction(
+        self, states: List[ComputationStage[K, V]]
+    ) -> List[Sequence[K, V]]:
+        return [
+            self.buffer.remove(
+                Matched.from_parts(c.stage, c.last_event), c.version
+            )
+            for c in states
+        ]
+
+    def _remove_pattern(self, computation: ComputationStage[K, V]) -> None:
+        if computation.last_event is None:
+            return
+        self.buffer.remove(
+            Matched.from_parts(computation.stage, computation.last_event),
+            computation.version,
+        )
+
+    def _match_computation(
+        self, computation: ComputationStage[K, V], event: Event[K, V]
+    ) -> List[ComputationStage[K, V]]:
+        if not computation.is_begin_state and computation.is_out_of_window(event.timestamp):
+            return []
+        return self._evaluate(computation, event, computation.stage, None)
+
+    def _matched_edges(
+        self,
+        previous_event: Optional[Event[K, V]],
+        current_event: Event[K, V],
+        version: DeweyVersion,
+        sequence: int,
+        previous_stage: Optional[Stage],
+        current_stage: Stage,
+    ) -> List[Edge]:
+        states = States(self.aggregates_store, current_event.key, sequence)
+        read_only = ReadOnlySharedVersionBuffer(self.buffer)
+        ctx_args = dict(
+            buffer=read_only,
+            version=version,
+            previous_stage=previous_stage,
+            current_stage=current_stage,
+            previous_event=previous_event,
+            current_event=current_event,
+            states=states,
+        )
+        return [e for e in current_stage.edges if e.predicate.accept(MatcherContext(**ctx_args))]
+
+    @staticmethod
+    def _is_branching(operations: List[EdgeOperation]) -> bool:
+        ops = set(operations)
+        return (
+            {EdgeOperation.PROCEED, EdgeOperation.TAKE} <= ops
+            or {EdgeOperation.IGNORE, EdgeOperation.TAKE} <= ops
+            or {EdgeOperation.IGNORE, EdgeOperation.BEGIN} <= ops
+            or {EdgeOperation.IGNORE, EdgeOperation.PROCEED} <= ops
+        )
+
+    def _evaluate(
+        self,
+        root: ComputationStage[K, V],
+        event: Event[K, V],
+        current_stage: Stage,
+        previous_stage: Optional[Stage],
+        computation: Optional[ComputationStage[K, V]] = None,
+    ) -> List[ComputationStage[K, V]]:
+        """Evaluate `current_stage`'s edges for one run; recursive over epsilon chains.
+
+        `root` is the queue item being processed (its begin-state re-add rule
+        applies once, at any depth); `computation` is the effective run state
+        at this recursion level (version possibly extended by addStage).
+        """
+        if computation is None:
+            computation = root
+
+        sequence_id = computation.sequence
+        previous_event = computation.last_event
+        version = computation.version
+
+        matched_edges = self._matched_edges(
+            previous_event, event, version, sequence_id, previous_stage, current_stage
+        )
+        operations = [e.operation for e in matched_edges]
+        is_branching = self._is_branching(operations)
+        ignored = EdgeOperation.IGNORE in operations
+
+        start_time = event.timestamp if root.is_begin_state else computation.timestamp
+
+        next_stages: List[ComputationStage[K, V]] = []
+        consumed = False
+        proceed = False
+
+        for edge in matched_edges:
+            op = edge.operation
+
+            if op in (EdgeOperation.PROCEED, EdgeOperation.SKIP_PROCEED):
+                next_computation = computation
+                if self._is_forwarding_to_next_stage(current_stage, computation, edge):
+                    next_computation = computation.with_version(version.add_stage())
+                prev_for_descent = (
+                    previous_stage if op == EdgeOperation.SKIP_PROCEED else current_stage
+                )
+                descended = self._evaluate(
+                    root, event, edge.target, prev_for_descent, next_computation
+                )
+                next_stages.extend(descended)
+                if descended:
+                    proceed = True
+
+            elif op == EdgeOperation.TAKE:
+                # Consume on the self loop: the run stays at this stage.
+                next_stages.append(
+                    ComputationStage(
+                        stage=Stage.new_epsilon(current_stage, current_stage),
+                        version=version,
+                        sequence=sequence_id,
+                        last_event=event,
+                        timestamp=start_time,
+                    )
+                )
+                if not is_branching or ignored:
+                    self._put_to_buffer(current_stage, previous_stage, previous_event, event, version)
+                else:
+                    self._put_to_buffer(
+                        current_stage, previous_stage, previous_event, event, version.add_run()
+                    )
+                consumed = True
+
+            elif op == EdgeOperation.BEGIN:
+                self._put_to_buffer(current_stage, previous_stage, previous_event, event, version)
+                next_stages.append(
+                    ComputationStage(
+                        stage=Stage.new_epsilon(current_stage, edge.target),
+                        version=version,
+                        sequence=sequence_id,
+                        last_event=event,
+                        timestamp=start_time,
+                    )
+                )
+                consumed = True
+
+            elif op == EdgeOperation.IGNORE:
+                if not is_branching:
+                    next_stages.append(replace(computation, is_ignored=True, is_branching=False))
+
+        if is_branching:
+            if consumed:
+                self.runs += 1
+                new_sequence = self.runs
+                last_event = previous_event if ignored else event
+                prev_is_begin = previous_stage is not None and previous_stage.is_begin
+                if previous_stage is not None:
+                    branch_stage = Stage.new_epsilon(previous_stage, current_stage)
+                else:
+                    # Begin-stage branching (untestable in the reference:
+                    # NFA.java:293 would NPE); park the clone at the current
+                    # stage itself.
+                    branch_stage = Stage.new_epsilon(current_stage, current_stage)
+                    prev_is_begin = True
+                run_offset = 2 if (prev_is_begin and len(version.digits) >= 2) else 1
+                next_version = version.add_run(run_offset)
+                next_stages.append(
+                    ComputationStage(
+                        stage=branch_stage,
+                        version=next_version,
+                        sequence=new_sequence,
+                        last_event=last_event,
+                        timestamp=start_time,
+                        is_branching=True,
+                    )
+                )
+                for agg_name in self.aggregates_names:
+                    self.aggregates_store.branch(event.key, agg_name, sequence_id, new_sequence)
+                if previous_stage is not None and not previous_stage.is_begin:
+                    self.buffer.branch(previous_stage, previous_event, version)
+            elif not proceed:
+                next_stages.append(root)
+
+        if consumed:
+            self._evaluate_aggregates(current_stage, sequence_id, event)
+
+        # The begin state is always re-added so new matches can start.
+        if root.is_begin_state and not root.is_forwarding:
+            if consumed:
+                self.runs += 1
+                new_version = version if not next_stages else version.add_run()
+                next_stages.append(
+                    ComputationStage(
+                        stage=root.stage,
+                        version=new_version,
+                        sequence=self.runs,
+                    )
+                )
+            else:
+                next_stages.append(root)
+
+        return next_stages
+
+    @staticmethod
+    def _is_forwarding_to_next_stage(
+        current_stage: Stage, computation: ComputationStage, edge: Edge
+    ) -> bool:
+        return (
+            edge.target.name != current_stage.name
+            and not computation.is_branching
+            and not computation.is_ignored
+        )
+
+    def _put_to_buffer(
+        self,
+        current_stage: Stage,
+        previous_stage: Optional[Stage],
+        previous_event: Optional[Event[K, V]],
+        event: Event[K, V],
+        version: DeweyVersion,
+    ) -> None:
+        if previous_stage is not None:
+            self.buffer.put(current_stage, event, previous_stage, previous_event, version)
+        else:
+            self.buffer.put(current_stage, event, version=version)
+
+    def _evaluate_aggregates(self, stage: Stage, sequence: int, event: Event[K, V]) -> None:
+        for aggregator in stage.aggregates:
+            current = self.aggregates_store.find(event.key, aggregator.name, sequence)
+            if current is None:
+                current = aggregator.initial
+            states = States(self.aggregates_store, event.key, sequence)
+
+            def env_factory(cur, _agg=aggregator, _states=states):
+                return FoldEnv(event, _states, _agg.name, cur)
+
+            new_value = aggregator.apply(event.key, event.value, current, env_factory)
+            self.aggregates_store.put(event.key, aggregator.name, sequence, new_value)

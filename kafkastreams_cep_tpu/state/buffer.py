@@ -1,0 +1,198 @@
+"""Shared versioned buffer: the SASE partial-match pointer graph.
+
+Re-design of the reference buffer
+(reference: core/.../cep/state/SharedVersionedBufferStore.java:32-77,
+state/internal/SharedVersionedBufferStoreImpl.java:45-212,
+state/internal/MatchedEvent.java, state/internal/Matched.java). Partial
+matches of all simultaneous runs are stored once in a compact pointer graph:
+nodes are keyed by (stage name, stage type, event id); each node holds a
+refcount and a list of version-tagged predecessor pointers. Sequence
+extraction walks pointers backwards choosing the predecessor whose version
+is Dewey-compatible with the requested one.
+
+The host store is a plain dict (the oracle). The device equivalent is an
+HBM-resident node pool with the same (stage, event) keying and refcount
+discipline (ops/engine.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Generic, List, Optional, Tuple, TypeVar
+
+from ..core.dewey import DeweyVersion
+from ..core.event import Event
+from ..core.sequence import Sequence, SequenceBuilder
+from ..pattern.stages import Stage, StateType
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+@dataclass(frozen=True)
+class Matched:
+    """Node key: stage identity + event identity (Matched.java:21-70)."""
+
+    stage_name: str
+    stage_type: StateType
+    topic: str
+    partition: int
+    offset: int
+
+    @staticmethod
+    def from_parts(stage: Stage, event: Event) -> "Matched":
+        return Matched(stage.name, stage.type, event.topic, event.partition, event.offset)
+
+
+class Pointer:
+    """A version-tagged predecessor pointer (MatchedEvent.Pointer)."""
+
+    __slots__ = ("version", "key")
+
+    def __init__(self, version: DeweyVersion, key: Optional[Matched]) -> None:
+        self.version = version
+        self.key = key
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Pointer):
+            return NotImplemented
+        return self.version == other.version and self.key == other.key
+
+    def __hash__(self) -> int:
+        return hash((self.version, self.key))
+
+    def __repr__(self) -> str:
+        return f"Pointer(version={self.version}, key={self.key})"
+
+
+class BufferNode(Generic[K, V]):
+    """A stored event + refcount + predecessor pointers (MatchedEvent.java)."""
+
+    __slots__ = ("key", "value", "timestamp", "refs", "predecessors")
+
+    def __init__(self, key: K, value: V, timestamp: int) -> None:
+        self.key = key
+        self.value = value
+        self.timestamp = timestamp
+        self.refs = 1
+        self.predecessors: List[Pointer] = []
+
+    def add_predecessor(self, version: DeweyVersion, key: Optional[Matched]) -> None:
+        self.predecessors.append(Pointer(version, key))
+
+    def pointer_by_version(self, version: DeweyVersion) -> Optional[Pointer]:
+        for pointer in self.predecessors:
+            if version.is_compatible(pointer.version):
+                return pointer
+        return None
+
+    def decrement_ref(self) -> int:
+        if self.refs > 0:
+            self.refs -= 1
+        return self.refs
+
+    def __repr__(self) -> str:
+        return (
+            f"BufferNode(value={self.value!r}, ts={self.timestamp}, refs={self.refs}, "
+            f"preds={self.predecessors!r})"
+        )
+
+
+class SharedVersionedBuffer(Generic[K, V]):
+    """Dict-backed shared versioned buffer (the host oracle store)."""
+
+    def __init__(self) -> None:
+        self._store: Dict[Matched, BufferNode[K, V]] = {}
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    # -- writes --------------------------------------------------------------
+    def put(
+        self,
+        curr_stage: Stage,
+        curr_event: Event[K, V],
+        prev_stage: Optional[Stage] = None,
+        prev_event: Optional[Event[K, V]] = None,
+        version: Optional[DeweyVersion] = None,
+    ) -> None:
+        """Append an event; with a predecessor, link a version-tagged pointer."""
+        assert version is not None
+        if prev_stage is None:
+            # Root put: new node with a null-predecessor pointer recording the
+            # version (the run) it belongs to.
+            node = BufferNode(curr_event.key, curr_event.value, curr_event.timestamp)
+            node.add_predecessor(version, None)
+            self._store[Matched.from_parts(curr_stage, curr_event)] = node
+            return
+
+        prev_key = Matched.from_parts(prev_stage, prev_event)
+        curr_key = Matched.from_parts(curr_stage, curr_event)
+
+        if prev_key not in self._store:
+            raise ValueError(f"Cannot find predecessor event for {prev_key}")
+
+        node = self._store.get(curr_key)
+        if node is None:
+            node = BufferNode(curr_event.key, curr_event.value, curr_event.timestamp)
+        node.add_predecessor(version, prev_key)
+        self._store[curr_key] = node
+
+    def branch(self, stage: Stage, event: Event[K, V], version: DeweyVersion) -> None:
+        """Increment refcounts along the predecessor chain of a new branch."""
+        pointer: Optional[Pointer] = Pointer(version, Matched.from_parts(stage, event))
+        while pointer is not None and pointer.key is not None:
+            node = self._store[pointer.key]
+            node.refs += 1
+            pointer = node.pointer_by_version(pointer.version)
+
+    # -- reads ---------------------------------------------------------------
+    def get(self, matched: Matched, version: DeweyVersion) -> Sequence[K, V]:
+        # Side-effect-free read: the reference's peek(remove=false) decrements
+        # refcounts only on a throwaway deserialized copy, which is
+        # equivalent to not decrementing at all.
+        return self._peek(matched, version, remove=False, decrement=False)
+
+    def remove(self, matched: Matched, version: DeweyVersion) -> Sequence[K, V]:
+        return self._peek(matched, version, remove=True)
+
+    def _peek(
+        self, matched: Matched, version: DeweyVersion, remove: bool, decrement: bool = True
+    ) -> Sequence[K, V]:
+        pointer: Optional[Pointer] = Pointer(version, matched)
+        builder: SequenceBuilder[K, V] = SequenceBuilder()
+
+        while pointer is not None and pointer.key is not None:
+            key = pointer.key
+            node = self._store.get(key)
+            if node is None:
+                break
+            refs_left = node.decrement_ref() if decrement else node.refs
+            if remove and refs_left == 0 and len(node.predecessors) <= 1:
+                del self._store[key]
+
+            builder.add(
+                key.stage_name,
+                Event(node.key, node.value, node.timestamp, key.topic, key.partition, key.offset),
+            )
+            pointer = node.pointer_by_version(pointer.version)
+            if remove and pointer is not None and refs_left == 0:
+                # Prune the traversed pointer and write the node back -- even
+                # if it was just deleted above. Deletion only sticks for the
+                # chain-end node; interior nodes are resurrected with the
+                # pruned pointer list so sibling branches can still extract
+                # their sequences (SharedVersionedBufferStoreImpl.java:187-198).
+                if pointer in node.predecessors:
+                    node.predecessors.remove(pointer)
+                self._store[key] = node
+
+        return builder.build(reversed_=True)
+
+
+class ReadOnlySharedVersionBuffer(Generic[K, V]):
+    """Read-only facade handed to sequence predicates (ReadOnlySharedVersionBuffer.java)."""
+
+    def __init__(self, buffer: SharedVersionedBuffer[K, V]) -> None:
+        self._buffer = buffer
+
+    def get(self, matched: Matched, version: DeweyVersion) -> Sequence[K, V]:
+        return self._buffer.get(matched, version)

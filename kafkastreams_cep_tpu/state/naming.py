@@ -1,0 +1,31 @@
+"""Store/namespace naming scheme for deployed queries.
+
+Mirrors the reference naming contract
+(reference: core/.../cep/state/QueryStores.java:32-52): each query owns
+three stores named `<query>-streamscep-{matched,states,aggregates}`,
+lowercased. Checkpoint directories and changelog streams reuse these names
+so operators of the reference find the same layout here.
+"""
+from __future__ import annotations
+
+STATES_SUFFIX = "-streamscep-states"
+MATCHED_SUFFIX = "-streamscep-matched"
+AGGREGATES_SUFFIX = "-streamscep-aggregates"
+
+
+def normalize_query_name(query_name: str) -> str:
+    # NOTE: the reference intends to strip whitespace but uses literal
+    # String.replace (CEPProcessor.java:83) -- a no-op bug. We actually strip.
+    return "".join(query_name.split()).lower()
+
+
+def nfa_states_store(query_name: str) -> str:
+    return normalize_query_name(query_name) + STATES_SUFFIX
+
+
+def event_buffer_store(query_name: str) -> str:
+    return normalize_query_name(query_name) + MATCHED_SUFFIX
+
+
+def aggregates_store(query_name: str) -> str:
+    return normalize_query_name(query_name) + AGGREGATES_SUFFIX

@@ -1,0 +1,51 @@
+"""Per-key NFA execution-state store (checkpoint contract).
+
+Re-design of the reference durability layer
+(reference: core/.../cep/state/NFAStore.java:30-33,
+state/internal/NFAStoreImpl.java:60-84, NFAStates.java:33-80,
+Runned.java:24). The NFA's execution state -- run queue, runs counter, and
+per-topic offset high-water marks -- is externalized after every processed
+record and restored on resume; compiled stages are NOT stored, they are
+recompiled and re-linked by id (ComputationStageSerde.java:56-101).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any, Dict, Generic, List, Optional, TypeVar
+
+if TYPE_CHECKING:
+    from ..nfa.nfa import ComputationStage
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+@dataclass
+class NFAStates(Generic[K, V]):
+    """Serializable snapshot of one key's NFA (NFAStates.java:33-80)."""
+
+    computation_stages: List["ComputationStage"]
+    runs: int
+    latest_offsets: Dict[str, int] = field(default_factory=dict)
+
+    def latest_offset_for_topic(self, topic: str) -> Optional[int]:
+        return self.latest_offsets.get(topic)
+
+
+class NFAStore(Generic[K, V]):
+    """Dict-backed per-key snapshot store (NFAStoreImpl.java:60-84)."""
+
+    def __init__(self) -> None:
+        self._store: Dict[Any, NFAStates] = {}
+
+    def find(self, key: Any) -> Optional[NFAStates]:
+        return self._store.get(key)
+
+    def put(self, key: Any, states: NFAStates) -> None:
+        self._store[key] = states
+
+    def keys(self):
+        return self._store.keys()
+
+    def __len__(self) -> int:
+        return len(self._store)

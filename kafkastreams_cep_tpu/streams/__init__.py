@@ -1,0 +1,3 @@
+from .builder import CEPStream, ComplexStreamsBuilder, OutputStream, Record, Topology
+from .processor import CEPProcessor
+from .serde import Queried, sequence_to_dict, sequence_to_json

@@ -1,0 +1,149 @@
+"""Streams API: topology construction for CEP queries.
+
+Re-design of the reference streams surface
+(reference: core/.../cep/ComplexStreamsBuilder.java:61-107,
+CEPStream.java:37-74, org/apache/kafka/.../CEPStreamImpl.java:41-95).
+`ComplexStreamsBuilder.stream(topics)` returns a `CEPStream`; each
+`query(name, pattern)` registers a processor node plus its three state
+stores and returns a downstream stream of Sequences. Unlike the reference
+-- which must reach into Kafka's internals -- the topology here is owned by
+the framework, so wiring is direct.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Generic, List, Optional, Sequence as Seq, TypeVar, Union
+
+from ..pattern.compiler import compile_pattern
+from ..pattern.pattern import Pattern
+from ..state.aggregates import AggregatesStore
+from ..state.buffer import SharedVersionedBuffer
+from ..state.naming import (
+    aggregates_store,
+    event_buffer_store,
+    nfa_states_store,
+    normalize_query_name,
+)
+from ..state.nfa_store import NFAStore
+from .processor import CEPProcessor
+from .serde import Queried
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class Record:
+    __slots__ = ("key", "value", "timestamp", "topic", "partition", "offset")
+
+    def __init__(self, key, value, timestamp=0, topic="", partition=0, offset=0):
+        self.key = key
+        self.value = value
+        self.timestamp = timestamp
+        self.topic = topic
+        self.partition = partition
+        self.offset = offset
+
+
+class QueryNode(Generic[K, V]):
+    """One registered query: processor + stores + downstream sinks."""
+
+    def __init__(self, name: str, pattern: Pattern, queried: Optional[Queried]) -> None:
+        self.name = normalize_query_name(name)
+        self.pattern = pattern
+        self.queried = queried
+        self.stores: Dict[str, Any] = {
+            nfa_states_store(name): NFAStore(),
+            event_buffer_store(name): SharedVersionedBuffer(),
+            aggregates_store(name): AggregatesStore(),
+        }
+        self.processor = CEPProcessor(
+            name,
+            pattern,
+            nfa_store=self.stores[nfa_states_store(name)],
+            buffer=self.stores[event_buffer_store(name)],
+            aggregates=self.stores[aggregates_store(name)],
+        )
+        self.downstream: List[Callable] = []
+
+
+class CEPStream(Generic[K, V]):
+    """A stream handle supporting `query(...)` (CEPStream.java:37-74)."""
+
+    def __init__(self, builder: "ComplexStreamsBuilder", topics: Seq[str]) -> None:
+        self._builder = builder
+        self.topics = list(topics)
+
+    def query(
+        self,
+        name: str,
+        pattern: Pattern,
+        queried: Optional[Queried] = None,
+    ) -> "OutputStream":
+        node = QueryNode(name, pattern, queried)
+        out = OutputStream(node)
+        self._builder._register(self, node, out)
+        return out
+
+
+class OutputStream:
+    """Downstream handle: collects matched sequences; supports peek/map sinks."""
+
+    def __init__(self, node: QueryNode) -> None:
+        self.node = node
+        self.records: List[Record] = []
+
+    def for_each(self, fn: Callable) -> "OutputStream":
+        self.node.downstream.append(fn)
+        return self
+
+
+class ComplexStreamsBuilder:
+    """Framework entry object (ComplexStreamsBuilder.java:61-107)."""
+
+    def __init__(self) -> None:
+        self._queries: List[tuple] = []
+
+    def stream(self, topics: Union[str, Seq[str]]) -> CEPStream:
+        if isinstance(topics, str):
+            topics = [topics]
+        return CEPStream(self, topics)
+
+    def _register(self, stream: CEPStream, node: QueryNode, out: OutputStream) -> None:
+        self._queries.append((stream, node, out))
+
+    def build(self) -> "Topology":
+        return Topology(self._queries)
+
+
+class Topology:
+    """The built processing graph, drivable record-by-record."""
+
+    def __init__(self, queries: List[tuple]) -> None:
+        self.queries = queries
+        self._offsets: Dict[tuple, int] = {}
+
+    def process(
+        self, topic: str, key, value, timestamp: int = 0, partition: int = 0, offset: Optional[int] = None
+    ) -> List[Record]:
+        """Drive one record through every query subscribed to `topic`."""
+        if offset is None:
+            offset = self._offsets.get((topic, partition), 0)
+        # Keep the auto-offset counter ahead of explicit offsets too, so
+        # later auto-assigned offsets never collide with used ones (event
+        # identity is (topic, partition, offset)).
+        self._offsets[(topic, partition)] = max(
+            self._offsets.get((topic, partition), 0), offset + 1
+        )
+        outputs: List[Record] = []
+        for stream, node, out in self.queries:
+            if topic not in stream.topics:
+                continue
+            sequences = node.processor.process(
+                key, value, timestamp=timestamp, topic=topic, partition=partition, offset=offset
+            )
+            for seq in sequences:
+                record = Record(key, seq, timestamp, topic, partition, offset)
+                out.records.append(record)
+                outputs.append(record)
+                for fn in node.downstream:
+                    fn(key, seq)
+        return outputs

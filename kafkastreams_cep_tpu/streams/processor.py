@@ -1,0 +1,98 @@
+"""Per-record CEP processor: the host-path stream driver.
+
+Re-design of the reference processor
+(reference: core/.../cep/processor/CEPProcessor.java:45-171). Per record it
+loads (or creates) the key's NFA from the states store, applies the
+high-water-mark idempotence check (skip records whose offset is below the
+persisted offset for their topic), runs the match loop, persists the updated
+snapshot, and forwards each completed Sequence downstream.
+
+The TPU path replaces the inner `nfa.match_pattern` call with the
+micro-batched device engine while keeping this store/HWM contract
+(ops/engine.py, streams/device_processor.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Generic, List, Optional, Tuple, TypeVar
+
+from ..core.event import Event
+from ..core.sequence import Sequence
+from ..nfa.nfa import NFA, initial_computation_stage
+from ..pattern.compiler import compile_pattern
+from ..pattern.pattern import Pattern
+from ..pattern.stages import Stages
+from ..state.aggregates import AggregatesStore
+from ..state.buffer import SharedVersionedBuffer
+from ..state.naming import normalize_query_name
+from ..state.nfa_store import NFAStates, NFAStore
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class CEPProcessor(Generic[K, V]):
+    """Host per-record driver bound to the three query stores."""
+
+    def __init__(
+        self,
+        query_name: str,
+        pattern_or_stages: Any,
+        nfa_store: Optional[NFAStore] = None,
+        buffer: Optional[SharedVersionedBuffer] = None,
+        aggregates: Optional[AggregatesStore] = None,
+    ) -> None:
+        if isinstance(pattern_or_stages, Pattern):
+            self.stages: Stages = compile_pattern(pattern_or_stages)
+        else:
+            self.stages = pattern_or_stages
+        self.query_name = normalize_query_name(query_name)
+        self.nfa_store = nfa_store if nfa_store is not None else NFAStore()
+        self.buffer = buffer if buffer is not None else SharedVersionedBuffer()
+        self.aggregates = aggregates if aggregates is not None else AggregatesStore()
+
+    def _load_nfa(self, key: K) -> Tuple[NFA, NFAStates]:
+        snapshot = self.nfa_store.find(key)
+        if snapshot is not None:
+            nfa = NFA(
+                self.aggregates,
+                self.buffer,
+                self.stages.defined_states(),
+                snapshot.computation_stages,
+                snapshot.runs,
+            )
+            return nfa, snapshot
+        nfa = NFA.build(self.stages, self.aggregates, self.buffer)
+        return nfa, NFAStates(list(nfa.computation_stages), nfa.runs)
+
+    def process(
+        self,
+        key: K,
+        value: V,
+        timestamp: int = 0,
+        topic: str = "",
+        partition: int = 0,
+        offset: int = 0,
+    ) -> List[Sequence[K, V]]:
+        """Process one record; returns completed matches for this key."""
+        if key is None or value is None:
+            return []
+        nfa, snapshot = self._load_nfa(key)
+
+        # The reference keys the HWM by topic only because each of its
+        # processor tasks owns exactly one partition; here one processor may
+        # see every partition, so the mark is per (topic, partition).
+        hwm_key = f"{topic}#{partition}"
+        latest = snapshot.latest_offset_for_topic(hwm_key)
+        if latest is not None and offset < latest:
+            # Replayed record below the high-water mark: at-least-once dedup.
+            return []
+
+        event = Event(key, value, timestamp, topic, partition, offset)
+        sequences = nfa.match_pattern(event)
+
+        offsets = dict(snapshot.latest_offsets)
+        offsets[hwm_key] = offset + 1
+        self.nfa_store.put(
+            key, NFAStates(list(nfa.computation_stages), nfa.runs, offsets)
+        )
+        return sequences

@@ -1,0 +1,67 @@
+"""Egress serialization and key/value schema specs.
+
+Re-design of the reference's output serde and serde-holder
+(reference: core/.../cep/JsonSequenceSerde.java:26-85, Queried.java:26-88).
+`sequence_to_json` reproduces the reference's output JSON shape byte-for-byte
+for the stock demo golden outputs (README.md:375-400).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Optional
+
+from ..core.sequence import Sequence
+
+
+def _event_value_repr(value: Any) -> Any:
+    """The reference serializes each matched event's *name* field when the
+    value is a POJO with a name (stock demo); for plain values it emits the
+    value itself."""
+    if isinstance(value, dict) and "name" in value:
+        return value["name"]
+    name = getattr(value, "name", None)
+    if name is not None:
+        return name
+    return value
+
+
+def sequence_to_dict(sequence: Sequence) -> dict:
+    return {
+        "events": [
+            {
+                "name": staged.stage,
+                "events": [_event_value_repr(e.value) for e in staged.events],
+            }
+            for staged in sequence.matched
+        ]
+    }
+
+
+def sequence_to_json(sequence: Sequence) -> str:
+    return json.dumps(sequence_to_dict(sequence), separators=(",", ":"))
+
+
+class Queried:
+    """Key/value schema holder for a deployed query (Queried.java:26-88).
+
+    In the TPU framework this carries the event schema used to pack values
+    into device columns (ops/schema.py) in addition to optional host codecs.
+    """
+
+    def __init__(
+        self,
+        key_serde: Optional[Callable[[Any], bytes]] = None,
+        value_serde: Optional[Callable[[Any], bytes]] = None,
+        schema: Optional[Any] = None,
+    ) -> None:
+        self.key_serde = key_serde
+        self.value_serde = value_serde
+        self.schema = schema
+
+    @staticmethod
+    def with_(key_serde=None, value_serde=None, schema=None) -> "Queried":
+        return Queried(key_serde, value_serde, schema)
+
+    @staticmethod
+    def with_schema(schema) -> "Queried":
+        return Queried(schema=schema)

@@ -1,0 +1,13 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh.
+
+Device-path tests exercise multi-chip sharding on virtual CPU devices; the
+real-TPU benchmark path is driven by bench.py instead.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

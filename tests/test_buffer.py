@@ -1,0 +1,80 @@
+"""Shared versioned buffer conformance (reference: SharedVersionedBufferTest.java:52-94)."""
+from kafkastreams_cep_tpu import DeweyVersion, Event, Matched, SharedVersionedBuffer
+from kafkastreams_cep_tpu.pattern.stages import Stage, StateType
+
+TOPIC = "topic-test"
+
+ev1 = Event("k1", "v1", 1000000001, TOPIC, 0, 0)
+ev2 = Event("k2", "v2", 1000000002, TOPIC, 0, 1)
+ev3 = Event("k3", "v3", 1000000003, TOPIC, 0, 2)
+ev4 = Event("k4", "v4", 1000000004, TOPIC, 0, 3)
+ev5 = Event("k5", "v5", 1000000005, TOPIC, 0, 4)
+
+first = Stage(0, "first", StateType.BEGIN)
+second = Stage(1, "second", StateType.NORMAL)
+latest = Stage(2, "latest", StateType.FINAL)
+
+
+def test_extract_patterns_with_one_run():
+    buffer = SharedVersionedBuffer()
+    buffer.put(first, ev1, version=DeweyVersion("1"))
+    buffer.put(second, ev2, first, ev1, DeweyVersion("1.0"))
+    buffer.put(latest, ev3, second, ev2, DeweyVersion("1.0.0"))
+
+    sequence = buffer.get(Matched.from_parts(latest, ev3), DeweyVersion("1.0.0"))
+    assert sequence.size() == 3
+    assert sequence.get_by_name("latest").events[0] == ev3
+    assert sequence.get_by_name("second").events[0] == ev2
+    assert sequence.get_by_name("first").events[0] == ev1
+
+
+def test_extract_patterns_with_branching_run():
+    buffer = SharedVersionedBuffer()
+    buffer.put(first, ev1, version=DeweyVersion("1"))
+    buffer.put(second, ev2, first, ev1, DeweyVersion("1.0"))
+    buffer.put(latest, ev3, second, ev2, DeweyVersion("1.0.0"))
+
+    buffer.put(second, ev3, second, ev2, DeweyVersion("1.1"))
+    buffer.put(second, ev4, second, ev3, DeweyVersion("1.1"))
+    buffer.put(latest, ev5, second, ev4, DeweyVersion("1.1.0"))
+
+    seq1 = buffer.get(Matched.from_parts(latest, ev3), DeweyVersion("1.0.0"))
+    assert seq1.size() == 3
+    assert seq1.get_by_name("latest").events[0] == ev3
+    assert seq1.get_by_name("second").events[0] == ev2
+    assert seq1.get_by_name("first").events[0] == ev1
+
+    seq2 = buffer.get(Matched.from_parts(latest, ev5), DeweyVersion("1.1.0"))
+    assert seq2.size() == 5
+    assert len(seq2.get_by_name("latest").events) == 1
+    assert len(seq2.get_by_name("second").events) == 3
+    assert len(seq2.get_by_name("first").events) == 1
+
+
+def test_stage_order_reversed_on_extract():
+    buffer = SharedVersionedBuffer()
+    buffer.put(first, ev1, version=DeweyVersion("1"))
+    buffer.put(second, ev2, first, ev1, DeweyVersion("1.0"))
+    buffer.put(latest, ev3, second, ev2, DeweyVersion("1.0.0"))
+
+    sequence = buffer.get(Matched.from_parts(latest, ev3), DeweyVersion("1.0.0"))
+    assert [s.stage for s in sequence.matched] == ["first", "second", "latest"]
+
+
+def test_remove_prunes_chain():
+    """Removal walks the chain decrementing refs; interior nodes are written
+    back with the traversed pointer pruned (only the chain-end deletion
+    sticks -- SharedVersionedBufferStoreImpl.java:187-198), leaving them
+    unreferenced and unreachable."""
+    buffer = SharedVersionedBuffer()
+    buffer.put(first, ev1, version=DeweyVersion("1"))
+    buffer.put(second, ev2, first, ev1, DeweyVersion("1.0"))
+    buffer.put(latest, ev3, second, ev2, DeweyVersion("1.0.0"))
+
+    assert len(buffer) == 3
+    buffer.remove(Matched.from_parts(latest, ev3), DeweyVersion("1.0.0"))
+    # Every node is left dead: zero refs, empty predecessor lists
+    # (collectible; extraction of this version is no longer possible).
+    for node in buffer._store.values():
+        assert node.refs == 0
+        assert node.predecessors == []

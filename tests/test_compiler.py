@@ -1,0 +1,130 @@
+"""Pattern->NFA compiler conformance (reference: StagesFactoryTest.java:36-157)."""
+import pytest
+
+from kafkastreams_cep_tpu import (
+    EdgeOperation,
+    InvalidPatternException,
+    QueryBuilder,
+    StateType,
+    compile_pattern,
+    value,
+)
+
+STAGE_1 = "stage-1"
+STAGE_2 = "stage-2"
+STAGE_3 = "stage-3"
+
+
+def test_invalid_final_one_or_more_stage():
+    pattern = QueryBuilder().select().one_or_more().where(value() == "N/A").build()
+    with pytest.raises(InvalidPatternException):
+        compile_pattern(pattern)
+
+
+def test_invalid_final_optional_stage():
+    pattern = QueryBuilder().select().optional().where(value() == "N/A").build()
+    with pytest.raises(InvalidPatternException):
+        compile_pattern(pattern)
+
+
+def test_single_stage():
+    pattern = QueryBuilder().select(STAGE_1).where(value() == 0).build()
+    stages = compile_pattern(pattern).stages
+
+    assert len(stages) == 2
+    final, begin = stages
+    assert final.type == StateType.FINAL
+    assert len(final.edges) == 0
+    assert begin.type == StateType.BEGIN
+    assert len(begin.edges) == 1
+    assert begin.edges[0].is_op(EdgeOperation.BEGIN)
+    assert begin.edges[0].target is final
+    assert begin.name == STAGE_1
+
+
+def test_multiple_stages():
+    pattern = (
+        QueryBuilder()
+        .select(STAGE_1).where(value() == 0)
+        .then()
+        .select(STAGE_2).where(value() % 2 == 0)
+        .then()
+        .select(STAGE_3).where(value() > 100)
+        .build()
+    )
+    stages = compile_pattern(pattern).stages
+
+    assert len(stages) == 4
+    assert stages[0].type == StateType.FINAL
+    assert stages[1].type == StateType.NORMAL and stages[1].name == STAGE_3
+    assert stages[2].type == StateType.NORMAL and stages[2].name == STAGE_2
+    assert stages[3].type == StateType.BEGIN and stages[3].name == STAGE_1
+
+
+def test_one_or_more_expansion():
+    pattern = (
+        QueryBuilder()
+        .select(STAGE_1).where(value() == 0)
+        .then()
+        .select(STAGE_2).one_or_more().where(value() % 2 == 0)
+        .then()
+        .select(STAGE_3).where(value() > 100)
+        .build()
+    )
+    stages = compile_pattern(pattern).stages
+
+    assert len(stages) == 5
+
+    final = stages[0]
+    assert final.type == StateType.FINAL
+
+    stage3 = stages[1]
+    assert stage3.type == StateType.NORMAL and stage3.name == STAGE_3
+    assert stage3.edges[0].operation == EdgeOperation.BEGIN
+    assert stage3.edges[0].target.name == final.name
+
+    stage2 = stages[2]
+    assert stage2.type == StateType.NORMAL and stage2.name == STAGE_2
+    assert stage2.edges[0].operation == EdgeOperation.TAKE
+    assert stage2.edges[0].target.name == stage3.name
+    assert stage2.edges[1].operation == EdgeOperation.PROCEED
+    assert stage2.edges[1].target.name == stage3.name
+
+    internal2 = stages[3]
+    assert internal2.type == StateType.NORMAL and internal2.name == STAGE_2
+    assert internal2.edges[0].operation == EdgeOperation.BEGIN
+
+    begin = stages[4]
+    assert begin.type == StateType.BEGIN and begin.name == STAGE_1
+
+
+def test_times_expansion():
+    # times(n) expands into n-1 chained internal BEGIN stages
+    # (StagesFactory.java:141-157).
+    pattern = (
+        QueryBuilder()
+        .select(STAGE_1).where(value() == "A")
+        .then()
+        .select(STAGE_2).times(3).where(value() == "C")
+        .then()
+        .select(STAGE_3).where(value() == "E")
+        .build()
+    )
+    stages = compile_pattern(pattern).stages
+    # final, stage-3, stage-2 (x3: main + 2 internal), stage-1
+    assert len(stages) == 6
+    names = [s.name for s in stages]
+    assert names == ["$final", STAGE_3, STAGE_2, STAGE_2, STAGE_2, STAGE_1]
+
+
+def test_window_pushed_to_all_stages():
+    pattern = (
+        QueryBuilder()
+        .select(STAGE_1).where(value() == "A")
+        .then()
+        .select(STAGE_2).where(value() == "B").within(minutes=5)
+        .build()
+    )
+    stages = compile_pattern(pattern).stages
+    assert stages[1].window_ms == 300_000  # stage-2 carries its own window
+    assert stages[2].window_ms == 300_000  # stage-1 inherits successor's window

@@ -1,0 +1,473 @@
+"""NFA engine conformance: the behavioral spec.
+
+Ports the reference's 14 engine scenarios (reference: NFATest.java:47-874),
+which encode the SASE semantics: run counts, branching, Dewey versioning and
+match ordering for every cardinality/strategy combination. Each docstring
+names the scenario.
+"""
+import itertools
+
+import pytest
+
+from kafkastreams_cep_tpu import (
+    AggregatesStore,
+    Event,
+    NFA,
+    QueryBuilder,
+    Selected,
+    SequenceBuilder,
+    SharedVersionedBuffer,
+    compile_pattern,
+)
+
+# Synthetic event fixtures (NFATest.java:49-56).
+TS = 1_000_000
+ev1 = Event("ev1", "A", TS, "test", 0, 0)
+ev2 = Event("ev2", "B", TS, "test", 0, 1)
+ev3 = Event("ev3", "C", TS, "test", 0, 2)
+ev4 = Event("ev4", "C", TS, "test", 0, 3)
+ev5 = Event("ev5", "D", TS, "test", 0, 4)
+ev6 = Event("ev6", "C", TS, "test", 0, 5)
+ev7 = Event("ev7", "D", TS, "test", 0, 6)
+ev8 = Event("ev8", "E", TS, "test", 0, 7)
+
+
+def is_equal_to(v):
+    return lambda event: event.value == v
+
+
+def new_nfa(pattern):
+    stages = compile_pattern(pattern)
+    return NFA.build(stages, AggregatesStore(), SharedVersionedBuffer())
+
+
+def simulate(nfa, *events):
+    out = []
+    for event in events:
+        out.extend(nfa.match_pattern(event))
+    return out
+
+
+def assert_nfa(nfa, runs, n_stages):
+    assert nfa.runs == runs
+    assert len(nfa.computation_stages) == n_stages
+
+
+def seq(*pairs, reversed_=False):
+    builder = SequenceBuilder()
+    for stage, event in pairs:
+        builder.add(stage, event)
+    return builder.build(reversed_)
+
+
+_offset = itertools.count()
+
+
+def next_event(key, value, topic="t1"):
+    return Event(key, value, TS, topic, 0, next(_offset))
+
+
+def test_stateful_condition():
+    """Fold registers drive stage predicates (NFATest.java:66-109)."""
+    pattern = (
+        QueryBuilder()
+        .select("first")
+        .where(lambda event, states: event.value > 0)
+        .fold("sum", lambda k, v, s: v)
+        .fold("count", lambda k, v, s: 1)
+        .then()
+        .select("second")
+        .one_or_more()
+        .where(lambda event, states: states.get("sum") // states.get("count") >= event.value)
+        .fold("sum", lambda k, v, s: s + v)
+        .fold("count", lambda k, v, s: s + 1)
+        .then()
+        .select("latest")
+        .where(lambda event, states: states.get("sum") // states.get("count") < event.value)
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    e1 = next_event("key", 5)
+    e2 = next_event("key", 3)
+    e3 = next_event("key", 4)
+    e4 = next_event("key", 10)
+    matches = simulate(nfa, e1, e2, e3, e4)
+
+    assert len(matches) == 1
+    assert_nfa(nfa, 5, 2)
+    expected = seq(("latest", e4), ("second", e3), ("second", e2), ("first", e1), reversed_=True)
+    assert matches[0] == expected
+
+
+def test_sequence_condition():
+    """Sequence predicates re-read the partial match (NFATest.java:111-157)."""
+
+    def avg(sequence):
+        values = [e.value for e in sequence]
+        return sum(values) / len(values) if values else 0.0
+
+    pattern = (
+        QueryBuilder()
+        .select("first")
+        .where(lambda event, states: event.value > 0)
+        .then()
+        .select("second")
+        .one_or_more()
+        .where(lambda event, sequence, states: avg(sequence) >= event.value)
+        .then()
+        .select("latest")
+        .where(lambda event, sequence, states: avg(sequence) < event.value)
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    e1 = next_event("key", 5)
+    e2 = next_event("key", 3)
+    e3 = next_event("key", 4)
+    e4 = next_event("key", 10)
+    matches = simulate(nfa, e1, e2, e3, e4)
+
+    assert len(matches) == 1
+    assert_nfa(nfa, 5, 2)
+    expected = seq(("latest", e4), ("second", e3), ("second", e2), ("first", e1), reversed_=True)
+    assert matches[0] == expected
+
+
+def test_times_occurrences():
+    """Pattern (A; C{3}; E) over A1 C3 C4 C6 E8 (NFATest.java:159-196)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second").times(3).where(is_equal_to("C"))
+        .then()
+        .select("latest").where(is_equal_to("E"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev3, ev4, ev6, ev8)
+    assert len(matches) == 1
+    assert_nfa(nfa, 2, 1)
+    expected = seq(
+        ("latest", ev8), ("second", ev6), ("second", ev4), ("second", ev3), ("first", ev1),
+        reversed_=True,
+    )
+    assert matches[0] == expected
+
+
+def test_zero_or_more_no_matching_inputs():
+    """Pattern (A; C*; D) over A1 D5 (NFATest.java:198-232)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second").zero_or_more().where(is_equal_to("C"))
+        .then()
+        .select("latest").where(is_equal_to("D"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev5)
+    assert len(matches) == 1
+    assert_nfa(nfa, 2, 1)
+    assert matches[0] == seq(("latest", ev5), ("first", ev1), reversed_=True)
+
+
+def test_zero_or_more_matching_inputs():
+    """Pattern (A; C*; D) over A1 C3 C4 D5 (NFATest.java:234-270)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second").zero_or_more().where(is_equal_to("C"))
+        .then()
+        .select("latest").where(is_equal_to("D"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev3, ev4, ev5)
+    assert len(matches) == 1
+    assert_nfa(nfa, 2, 1)
+    expected = seq(
+        ("latest", ev5), ("second", ev4), ("second", ev3), ("first", ev1), reversed_=True
+    )
+    assert matches[0] == expected
+
+
+def test_optional_times_no_matching_inputs():
+    """Pattern (A; C{2}?; D) over A1 D5 (NFATest.java:272-307)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second").times(2).optional().where(is_equal_to("C"))
+        .then()
+        .select("latest").where(is_equal_to("D"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev5)
+    assert len(matches) == 1
+    assert_nfa(nfa, 2, 1)
+    assert matches[0] == seq(("latest", ev5), ("first", ev1), reversed_=True)
+
+
+def test_optional_times_matching_inputs():
+    """Pattern (A; C{2}?; D) over A1 C3 C4 D5 (NFATest.java:309-346)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second").times(2).optional().where(is_equal_to("C"))
+        .then()
+        .select("latest").where(is_equal_to("D"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev3, ev4, ev5)
+    assert len(matches) == 1
+    assert_nfa(nfa, 2, 1)
+    expected = seq(
+        ("latest", ev5), ("second", ev4), ("second", ev3), ("first", ev1), reversed_=True
+    )
+    assert matches[0] == expected
+
+
+def test_times_skip_til_next_match():
+    """Pattern (A; C{3} skip-next; E) over A1 C3 C4 D5 C6 E8 (NFATest.java:348-385)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second", Selected.with_skip_til_next_match()).times(3).where(is_equal_to("C"))
+        .then()
+        .select("latest").where(is_equal_to("E"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev3, ev4, ev5, ev6, ev8)
+    assert len(matches) == 1
+    assert_nfa(nfa, 2, 1)
+    expected = seq(
+        ("latest", ev8), ("second", ev6), ("second", ev4), ("second", ev3), ("first", ev1),
+        reversed_=True,
+    )
+    assert matches[0] == expected
+
+
+def test_optional_stage_strict_contiguity():
+    """Pattern (A; B?; C) over A1 C3 (NFATest.java:387-421)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second").optional().where(is_equal_to("B"))
+        .then()
+        .select("latest").where(is_equal_to("C"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev3)
+    assert len(matches) == 1
+    assert_nfa(nfa, 2, 1)
+    assert matches[0] == seq(("latest", ev3), ("first", ev1), reversed_=True)
+
+
+def test_one_run_strict_contiguity():
+    """Pattern (A; B; C) over A1 B2 C3 (NFATest.java:423-457)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second").where(is_equal_to("B"))
+        .then()
+        .select("latest").where(is_equal_to("C"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev2, ev3)
+    assert len(matches) == 1
+    assert_nfa(nfa, 2, 1)
+    assert matches[0] == seq(("latest", ev3), ("second", ev2), ("first", ev1), reversed_=True)
+
+
+def test_one_run_multiple_match():
+    """Pattern (A; B; C+; D) over A1 B2 C3 C4 D5 (NFATest.java:459-498)."""
+    pattern = (
+        QueryBuilder()
+        .select("firstStage").where(is_equal_to("A"))
+        .then()
+        .select("secondStage").where(is_equal_to("B"))
+        .then()
+        .select("thirdStage").one_or_more().where(is_equal_to("C"))
+        .then()
+        .select("latestState").where(is_equal_to("D"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev2, ev3, ev4, ev5)
+    assert len(matches) == 1
+    assert_nfa(nfa, 2, 1)
+    expected = seq(
+        ("firstStage", ev1),
+        ("secondStage", ev2),
+        ("thirdStage", ev3),
+        ("thirdStage", ev4),
+        ("latestState", ev5),
+    )
+    assert matches[0] == expected
+
+
+def test_two_consecutive_skip_til_next_match():
+    """Pattern (A; C; D) skip-next over A1 B2 C3 C4 D5 (NFATest.java:500-532)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second", Selected.with_skip_til_next_match()).where(is_equal_to("C"))
+        .then()
+        .select("latest", Selected.with_skip_til_next_match()).where(is_equal_to("D"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev2, ev3, ev4, ev5)
+    assert len(matches) == 1
+    assert_nfa(nfa, 2, 1)
+    assert matches[0] == seq(("first", ev1), ("second", ev3), ("latest", ev5))
+
+
+def test_two_consecutive_skip_til_next_match_and_multiple_match():
+    """Pattern (A; C+; D) skip-next over A1 B2 C3 C4 D5 (NFATest.java:534-567)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second", Selected.with_skip_til_next_match()).one_or_more().where(is_equal_to("C"))
+        .then()
+        .select("latest", Selected.with_skip_til_next_match()).where(is_equal_to("D"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev2, ev3, ev4, ev5)
+    assert len(matches) == 1
+    assert matches[0] == seq(("first", ev1), ("second", ev3), ("second", ev4), ("latest", ev5))
+
+
+def test_two_consecutive_skip_til_any_match():
+    """Pattern (A; C; D) skip-any: branches yield 2 matches, 6 runs, 4 live
+    (NFATest.java:569-615)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second", Selected.with_skip_til_any_match()).where(is_equal_to("C"))
+        .then()
+        .select("latest", Selected.with_skip_til_any_match()).where(is_equal_to("D"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev2, ev3, ev4, ev5)
+
+    assert_nfa(nfa, 6, 4)
+    assert len(matches) == 2
+    assert matches[0] == seq(("first", ev1), ("second", ev3), ("latest", ev5))
+    assert matches[1] == seq(("first", ev1), ("second", ev4), ("latest", ev5))
+
+
+def test_multiple_match_and_skip_til_any_match():
+    """Pattern (A; C+ skip-any; D): 3 matches, 5 runs, 2 live (NFATest.java:617-672)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second", Selected.with_skip_til_any_match()).one_or_more().where(is_equal_to("C"))
+        .then()
+        .select("latest").where(is_equal_to("D"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev2, ev3, ev4, ev5)
+
+    assert_nfa(nfa, 5, 2)
+    assert len(matches) == 3
+    assert matches[0] == seq(("first", ev1), ("second", ev3), ("second", ev4), ("latest", ev5))
+    assert matches[1] == seq(("first", ev1), ("second", ev3), ("latest", ev5))
+    assert matches[2] == seq(("first", ev1), ("second", ev4), ("latest", ev5))
+
+
+def test_four_stage_two_consecutive_skip_til_any_match():
+    """Pattern (A; B; C skip-any; D skip-any): 2 matches, 6 runs, 4 live
+    (NFATest.java:674-724)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second").where(is_equal_to("B"))
+        .then()
+        .select("three", Selected.with_skip_til_any_match()).where(is_equal_to("C"))
+        .then()
+        .select("latest", Selected.with_skip_til_any_match()).where(is_equal_to("D"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev2, ev3, ev4, ev5)
+
+    assert_nfa(nfa, 6, 4)
+    assert len(matches) == 2
+    assert matches[0] == seq(("first", ev1), ("second", ev2), ("three", ev3), ("latest", ev5))
+    assert matches[1] == seq(("first", ev1), ("second", ev2), ("three", ev4), ("latest", ev5))
+
+
+def test_multiple_strategies():
+    """Pattern (A; B; C skip-any; D skip-next): 2 matches, 4 runs, 2 live
+    (NFATest.java:726-772)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second").where(is_equal_to("B"))
+        .then()
+        .select("three", Selected.with_skip_til_any_match()).where(is_equal_to("C"))
+        .then()
+        .select("latest", Selected.with_skip_til_next_match()).where(is_equal_to("D"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev2, ev3, ev4, ev5)
+
+    assert_nfa(nfa, 4, 2)
+    assert len(matches) == 2
+    assert matches[0] == seq(("first", ev1), ("second", ev2), ("three", ev3), ("latest", ev5))
+    assert matches[1] == seq(("first", ev1), ("second", ev2), ("three", ev4), ("latest", ev5))
+
+
+def test_skip_til_any_match_on_latest_stage():
+    """Pattern (A; B; C; D skip-any) over A1 B2 C3 D5 D7: run-queue shape is
+    asserted too (NFATest.java:774-834)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(is_equal_to("A"))
+        .then()
+        .select("second").where(is_equal_to("B"))
+        .then()
+        .select("three").where(is_equal_to("C"))
+        .then()
+        .select("latest", Selected.with_skip_til_any_match()).where(is_equal_to("D"))
+        .build()
+    )
+    nfa = new_nfa(pattern)
+    matches = simulate(nfa, ev1, ev2, ev3, ev5, ev7)
+
+    assert nfa.runs == 4
+    stages = nfa.computation_stages
+    assert len(stages) == 2
+    stage1, stage2 = stages
+    assert stage1.last_event == ev3
+    assert stage1.sequence == 4
+    assert stage1.stage.name == "three"
+    assert stage2.last_event is None
+    assert stage2.sequence == 2
+    assert stage2.stage.name == "first"
+
+    assert len(matches) == 2
+    assert matches[0] == seq(("first", ev1), ("second", ev2), ("three", ev3), ("latest", ev5))
+    assert matches[1] == seq(("first", ev1), ("second", ev2), ("three", ev3), ("latest", ev7))

@@ -1,0 +1,57 @@
+"""Processor-level conformance (reference: CEPProcessorTest.java:101-135):
+null key/value tolerance and high-water-mark replay dedup across topics."""
+from kafkastreams_cep_tpu import CEPProcessor, QueryBuilder, value
+from kafkastreams_cep_tpu.models.letters import letters_pattern
+
+
+def make_processor():
+    return CEPProcessor("test-query", letters_pattern())
+
+
+def test_null_key_or_value_skipped():
+    p = make_processor()
+    assert p.process(None, "A") == []
+    assert p.process("k", None) == []
+    assert len(p.nfa_store) == 0
+
+
+def test_high_water_mark_dedup():
+    p = make_processor()
+    p.process("k", "A", topic="t1", offset=0)
+    p.process("k", "B", topic="t1", offset=1)
+    # Replay below the HWM: ignored, state unchanged.
+    assert p.process("k", "Z", topic="t1", offset=0) == []
+    matches = p.process("k", "C", topic="t1", offset=2)
+    assert len(matches) == 1
+
+
+def test_high_water_mark_is_per_topic():
+    p = make_processor()
+    p.process("k", "A", topic="t1", offset=5)
+    # A different topic has its own high-water mark; offset 0 is fine there.
+    p.process("k", "B", topic="t2", offset=0)
+    matches = p.process("k", "C", topic="t1", offset=6)
+    assert len(matches) == 1
+
+
+def test_match_across_restore():
+    """Snapshot/restore: a fresh processor over the same stores resumes runs."""
+    p1 = make_processor()
+    p1.process("k", "A", topic="t1", offset=0)
+    p1.process("k", "B", topic="t1", offset=1)
+
+    p2 = CEPProcessor(
+        "test-query",
+        letters_pattern(),
+        nfa_store=p1.nfa_store,
+        buffer=p1.buffer,
+        aggregates=p1.aggregates,
+    )
+    matches = p2.process("k", "C", topic="t1", offset=2)
+    assert len(matches) == 1
+    staged = [(s.stage, [e.value for e in s.events]) for s in matches[0].matched]
+    assert staged == [
+        ("select-A", ["A"]),
+        ("select-B", ["B"]),
+        ("select-C", ["C"]),
+    ]

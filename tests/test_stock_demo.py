@@ -1,0 +1,50 @@
+"""Golden end-to-end check: the SIGMOD'08 stock demo.
+
+Replays the 8 golden stock events through the full DSL -> compiler ->
+processor -> JSON egress path and asserts the exact 4 JSON match strings
+(reference: CEPStockDemoTest.java:86-113, README.md:375-400). Runs both the
+closure-form pattern (StatefulMatcher parity) and the expression form
+(device-compilable).
+"""
+import pytest
+
+from kafkastreams_cep_tpu import ComplexStreamsBuilder, sequence_to_json
+from kafkastreams_cep_tpu.models.stocks import (
+    GOLDEN_EVENTS,
+    GOLDEN_MATCHES,
+    stocks_pattern,
+    stocks_pattern_host,
+)
+
+
+@pytest.mark.parametrize("pattern_fn", [stocks_pattern_host, stocks_pattern])
+def test_stock_demo_golden(pattern_fn):
+    builder = ComplexStreamsBuilder()
+    stream = builder.stream("stock-events")
+    out = stream.query("Stocks", pattern_fn())
+    topology = builder.build()
+
+    for i, event in enumerate(GOLDEN_EVENTS):
+        topology.process("stock-events", "K1", event, timestamp=i)
+
+    got = [sequence_to_json(r.value) for r in out.records]
+    assert got == GOLDEN_MATCHES
+    assert all(r.key == "K1" for r in out.records)
+
+
+def test_stock_demo_multi_key_isolation():
+    """Per-key NFA isolation: interleaved keys each produce their matches
+    (reference: CEPStreamIntegrationTest.java:121-172)."""
+    builder = ComplexStreamsBuilder()
+    stream = builder.stream("stock-events")
+    out = stream.query("Stocks", stocks_pattern())
+    topology = builder.build()
+
+    for i, event in enumerate(GOLDEN_EVENTS):
+        topology.process("stock-events", "K1", event, timestamp=i, offset=2 * i)
+        topology.process("stock-events", "K2", event, timestamp=i, offset=2 * i + 1)
+
+    k1 = [sequence_to_json(r.value) for r in out.records if r.key == "K1"]
+    k2 = [sequence_to_json(r.value) for r in out.records if r.key == "K2"]
+    assert k1 == GOLDEN_MATCHES
+    assert k2 == GOLDEN_MATCHES
