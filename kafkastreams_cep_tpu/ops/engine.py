@@ -1,0 +1,609 @@
+"""Device NFA engine: the jit-compiled, lane-vectorized SASE transition kernel.
+
+This is the TPU-native replacement for the reference's per-record, run-at-a-
+time evaluator (reference: core/.../cep/nfa/NFA.java:134-397). The host
+oracle (nfa/nfa.py) defines the conformance contract; this module implements
+the *same transition relation* as a data-parallel program:
+
+  * live runs live in a fixed-capacity structure-of-arrays lane table
+    (stage id, synthesized-epsilon target, Dewey digits as fixed-width i32
+    lanes, run id, last-buffer-node index, start timestamp, branching/ignored
+    flags) -- the device form of ComputationStage.java:30-91;
+  * the recursive epsilon descent (NFA.java:222-237) is unrolled to the
+    statically-known chain depth (CompiledQuery.max_depth): each level
+    evaluates one stage's edges for every lane at once;
+  * predicates are evaluated as vectorized masks: stateless predicates for
+    the whole micro-batch up front ([T, P] in one fused pass -- the
+    replacement for the per-edge virtual call, NFA.java:371-384), stateful
+    ones per (lane, event) against the fold-register file;
+  * one event-step emits up to 4*max_depth output slots per lane in exactly
+    the oracle's DFS order (consume/ignore emissions level-down, then
+    branch-clone and begin-re-add level-up, NFA.java:238-338) and compacts
+    them into the new lane table with a prefix-sum scatter, so queue order,
+    run counts and match order match the oracle;
+  * the shared versioned buffer (SharedVersionedBufferStoreImpl.java) becomes
+    an append-only node pool (event idx, stage name id, predecessor index).
+    Because every run tracks its last node *by index*, the Dewey-compatible
+    pointer routing of the reference's merged store is unnecessary: each
+    lineage owns its chain, branches share prefixes by construction, and
+    match extraction is a host-side (or batched-gather) predecessor walk.
+    Refcount GC is replaced by mark-sweep compaction at batch boundaries.
+
+Known, documented divergences from the oracle (both unobservable in the
+conformance suite; counted by the `seq_collisions` stat so a workload that
+hits them is detectable):
+
+  * fold registers are stored per lane with copy-on-emit; two live lanes
+    sharing one run id (possible after PROCEED+TAKE branching) receive their
+    own lane's updates rather than a shared per-run cell, and predicates read
+    the event-start snapshot rather than seeing earlier queue items' folds
+    within the same event;
+  * buffer-node refcounts are not maintained on device (GC is mark-sweep),
+    so the reference's refcount quirks (MatchedEvent.java:66-68) have no
+    analog here.
+
+The scan is vmap-able over a leading key axis (parallel/key_shard.py) and
+shards over a device mesh along that axis with `jax.sharding`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .tables import (
+    OP_BEGIN,
+    OP_NONE,
+    OP_TAKE,
+    PR_NONE,
+    PR_PROCEED,
+    PR_SKIP,
+    CompiledQuery,
+    DeviceEnv,
+)
+
+_I32_MAX = np.int64(2**31 - 1)
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Capacity knobs (SURVEY.md section 5.6: typed config, not a flag framework)."""
+
+    lanes: int = 64          # max simultaneous runs per key (run-lane pool)
+    nodes: int = 8192        # buffer node pool per key per batch window
+    matches: int = 1024      # match-descriptor ring per batch
+    digits: int = 0          # Dewey digit width; 0 = auto (n_stages + 2)
+
+    def dewey_width(self, query: CompiledQuery) -> int:
+        return self.digits if self.digits > 0 else query.n_stages + 2
+
+
+def init_state(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndarray]:
+    """Initial device state: one begin run, version `1`, run id 1.
+
+    Mirrors Stages.initialComputationStage (Stages.java:53-60).
+    """
+    R = config.lanes
+    D = config.dewey_width(query)
+    A = query.n_aggs
+    B = config.nodes
+    M = config.matches
+
+    ver = np.zeros((R, D), np.int32)
+    ver[0, 0] = 1
+    state = {
+        # -- run lane table (SoA ComputationStage) ---------------------------
+        "active": np.zeros(R, bool),
+        "src": np.zeros(R, np.int32),          # stage id (identity of the run's stage)
+        "eps": np.full(R, -1, np.int32),       # synthesized-epsilon PROCEED target
+        "ver": ver,                            # Dewey digits (zero-padded)
+        "vlen": np.zeros(R, np.int32),         # digit count
+        "seq": np.zeros(R, np.int32),          # run id (NFA.java runs counter)
+        "node": np.full(R, -1, np.int32),      # last matched event's buffer node
+        "ts": np.full(R, -1, np.int32),        # start timestamp (rebased ms)
+        "branching": np.zeros(R, bool),
+        "ignored": np.zeros(R, bool),
+        "regs": np.zeros((R, A), np.float32),  # fold registers (per lane)
+        "regs_set": np.zeros((R, A), bool),
+        "runs": np.asarray(1, np.int32),       # global run counter
+        # -- buffer node pool (slot B = overflow trash) ----------------------
+        "node_event": np.full(B + 1, -1, np.int32),   # global event index
+        "node_name": np.full(B + 1, -1, np.int32),    # stage (name, type) id
+        "node_pred": np.full(B + 1, -1, np.int32),    # predecessor node (-1 root)
+        "node_count": np.asarray(0, np.int32),
+        # -- match ring (slot M = overflow trash) ----------------------------
+        "match_node": np.full(M + 1, -1, np.int32),
+        "match_count": np.asarray(0, np.int32),
+        # -- observability counters (SURVEY.md section 5.1/5.5) --------------
+        "n_events": np.asarray(0, np.int32),
+        "n_branches": np.asarray(0, np.int32),
+        "n_expired": np.asarray(0, np.int32),
+        "lane_drops": np.asarray(0, np.int32),
+        "node_drops": np.asarray(0, np.int32),
+        "match_drops": np.asarray(0, np.int32),
+        "seq_collisions": np.asarray(0, np.int32),
+    }
+    state["active"][0] = True
+    state["src"][0] = query.begin_stage
+    state["vlen"][0] = 1
+    state["seq"][0] = 1
+    return {k: jnp.asarray(v) for k, v in state.items()}
+
+
+def _excl_cumsum(mask: jnp.ndarray) -> jnp.ndarray:
+    c = jnp.cumsum(mask.astype(jnp.int32))
+    return c - mask.astype(jnp.int32)
+
+
+def build_step(
+    query: CompiledQuery, config: EngineConfig
+) -> Callable[[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]], Tuple[Dict[str, jnp.ndarray], None]]:
+    """Build the one-event transition function (a `lax.scan` body).
+
+    The returned `step(state, x)` consumes one packed event
+    (x = column scalars + precomputed stateless predicate row + global event
+    index + validity flag) and returns the next state. All shapes static.
+    """
+    R = config.lanes
+    D = config.dewey_width(query)
+    A = query.n_aggs
+    B = config.nodes
+    M = config.matches
+    L = query.max_depth
+    P = query.n_preds
+    SLOTS = 4 * L
+
+    # Device-constant stage tables.
+    t_consume_op = jnp.asarray(query.consume_op)
+    t_consume_pred = jnp.asarray(query.consume_pred)
+    t_consume_target = jnp.asarray(query.consume_target)
+    t_ignore_pred = jnp.asarray(query.ignore_pred)
+    t_proceed_kind = jnp.asarray(query.proceed_kind)
+    t_proceed_pred = jnp.asarray(query.proceed_pred)
+    t_proceed_target = jnp.asarray(query.proceed_target)
+    # i64 window clamped into i32: rebased timestamps are i32, so a clamped
+    # huge window compares identically to "no expiry".
+    t_window = jnp.asarray(
+        np.where(query.window_ms < 0, -1, np.minimum(query.window_ms, _I32_MAX - 1)).astype(
+            np.int32
+        )
+    )
+    t_name_id = jnp.asarray(query.name_id)
+    t_pure_name = jnp.asarray(query.pure_name_id)
+    t_is_begin = jnp.asarray(query.is_begin)
+    t_is_final = jnp.asarray(query.is_final)
+    t_is_fwd = jnp.asarray(query.is_fwd)
+    t_fwd_final = jnp.asarray(query.fwd_final)
+
+    stateful = [bool(f) for f in query.pred_stateful]
+
+    # Flattened fold list [(stage, slot, fn)] preserving per-stage order
+    # (evaluateAggregates iterates a stage's folds sequentially,
+    # NFA.java:362-369).
+    flat_folds: List[Tuple[int, int, Callable]] = []
+    for stage_i, stage_folds in enumerate(query.folds):
+        for slot, fn in stage_folds:
+            flat_folds.append((stage_i, slot, fn))
+
+    def add_run(ver: jnp.ndarray, vlen: jnp.ndarray, off: jnp.ndarray) -> jnp.ndarray:
+        """DeweyVersion.addRun: +1 at digit (len - off) (DeweyVersion.java:58-67)."""
+        idx = vlen - off
+        onehot = (jnp.arange(D)[None, :] == idx[:, None]).astype(jnp.int32)
+        return ver + onehot
+
+    def step(state: Dict[str, jnp.ndarray], x: Dict[str, jnp.ndarray]):
+        ev_ts = x["ts"]
+        gidx = x["gidx"]
+
+        active = state["active"]
+        src = state["src"]
+        eps = state["eps"]
+        lane_node = state["node"]
+        lane_ts = state["ts"]
+        lane_seq = state["seq"]
+        regs_in = state["regs"]
+        regs_set_in = state["regs_set"]
+
+        # -- predicate mask matrix [R, P] ------------------------------------
+        # Stateless rows were evaluated for the whole batch up front; stateful
+        # predicates read the event-start register snapshot (all of a lane's
+        # predicate evaluations precede all of its folds in the oracle's DFS).
+        env = DeviceEnv(x, regs_in, regs_set_in, query.agg_slots, query.agg_defaults)
+        cols = []
+        for p in range(max(P, 1)):
+            if p < P and stateful[p]:
+                v = query.predicates[p](env)
+            elif p < P:
+                v = x["spred"][p]
+            else:
+                v = jnp.asarray(False)
+            cols.append(jnp.broadcast_to(jnp.asarray(v, bool), (R,)))
+        pred_vals = jnp.stack(cols, axis=1)
+
+        def pval(pid: jnp.ndarray) -> jnp.ndarray:
+            got = jnp.take_along_axis(pred_vals, pid.clip(0)[:, None], axis=1)[:, 0]
+            return got & (pid >= 0)
+
+        # -- window expiry (NFA.java:183-184; begin states never expire, and
+        # synthesized epsilon stages carry no window, Stage.java:247-251) ----
+        root_begin = t_is_begin[src]
+        eff_window = jnp.where(eps >= 0, -1, t_window[src])
+        expired = active & ~root_begin & (eff_window >= 0) & ((ev_ts - lane_ts) > eff_window)
+        active = active & ~expired
+
+        root_fwd = (eps >= 0) | t_is_fwd[src]
+        start_ts = jnp.where(root_begin, ev_ts, lane_ts)
+
+        # ==== downward pass: unrolled epsilon descent =======================
+        alive = active
+        cs = src
+        is_eps = eps >= 0
+        ceps = eps
+        ver = state["ver"]
+        vlen = state["vlen"]
+        br = state["branching"]
+        ig = state["ignored"]
+        ps = jnp.full(R, -1, jnp.int32)
+
+        levels: List[Dict[str, jnp.ndarray]] = []
+        for _l in range(L):
+            c_op = jnp.where(is_eps, OP_NONE, t_consume_op[cs])
+            c_m = alive & (c_op != OP_NONE) & pval(
+                jnp.where(is_eps, -1, t_consume_pred[cs])
+            )
+            take_m = c_m & (c_op == OP_TAKE)
+            begin_m = c_m & (c_op == OP_BEGIN)
+            ig_m = alive & ~is_eps & pval(t_ignore_pred[cs])
+            pk = jnp.where(is_eps, PR_PROCEED, t_proceed_kind[cs])
+            ptgt = jnp.where(is_eps, ceps, t_proceed_target[cs])
+            p_m = alive & (pk != PR_NONE) & (is_eps | pval(t_proceed_pred[cs]))
+            # Branching combos (NFA.java:392-397): PROCEED+TAKE, IGNORE+TAKE,
+            # IGNORE+BEGIN, IGNORE+PROCEED (SKIP_PROCEED does not count).
+            p_strict = p_m & (pk == PR_PROCEED)
+            branch_m = (p_strict & take_m) | (ig_m & (c_m | p_strict))
+
+            ptgt_c = ptgt.clip(0)
+            fwd_next = (
+                p_m
+                & (t_pure_name[ptgt_c] != t_pure_name[cs])
+                & ~br
+                & ~ig
+            )
+
+            levels.append(
+                dict(
+                    alive=alive, cs=cs, is_eps=is_eps, ver=ver, vlen=vlen,
+                    br=br, ig=ig, ps=ps, c_m=c_m, take_m=take_m,
+                    begin_m=begin_m, ig_m=ig_m, p_m=p_m, pk=pk, ptgt=ptgt_c,
+                    branch_m=branch_m,
+                )
+            )
+
+            # Descend (PROCEED/SKIP_PROCEED, NFA.java:222-237): extend the
+            # version when genuinely crossing stage names with clean flags;
+            # SKIP_PROCEED keeps the previous stage (NFA.java:232-236).
+            vlen = jnp.where(fwd_next, vlen + 1, vlen)
+            br = jnp.where(fwd_next, False, br)
+            ig = jnp.where(fwd_next, False, ig)
+            ps = jnp.where(pk == PR_SKIP, ps, cs).astype(jnp.int32)
+            alive = p_m
+            cs = ptgt_c
+            is_eps = jnp.zeros(R, bool)
+            ceps = jnp.full(R, -1, jnp.int32)
+
+        # ==== fold-register chain (deepest level first, NFA.java:319-321) ===
+        def apply_folds(v: Dict[str, jnp.ndarray], regs, regs_set):
+            for stage_i, slot, fn in flat_folds:
+                mask = v["c_m"] & (v["cs"] == stage_i)
+                fenv = DeviceEnv(x, regs, regs_set, query.agg_slots, query.agg_defaults)
+                val = jnp.broadcast_to(
+                    jnp.asarray(fn(fenv), jnp.float32), (R,)
+                )
+                regs = regs.at[:, slot].set(jnp.where(mask, val, regs[:, slot]))
+                regs_set = regs_set.at[:, slot].set(regs_set[:, slot] | mask)
+            return regs, regs_set
+
+        cur_regs, cur_set = regs_in, regs_set_in
+        clone_regs: List[Tuple[jnp.ndarray, jnp.ndarray]] = [None] * L  # type: ignore
+        for l in reversed(range(L)):
+            clone_regs[l] = (cur_regs, cur_set)  # pre-this-level snapshot for clones
+            if flat_folds:
+                cur_regs, cur_set = apply_folds(levels[l], cur_regs, cur_set)
+        final_regs, final_set = cur_regs, cur_set
+
+        # Same-run-id collision detector: >1 lane consuming with one run id
+        # in a single event (the documented per-lane-register divergence).
+        consuming = jnp.zeros(R, bool)
+        for l in range(L):
+            consuming = consuming | levels[l]["c_m"]
+        seq_sorted = jnp.sort(jnp.where(consuming, lane_seq, -jnp.arange(R) - 1))
+        collide = jnp.any(seq_sorted[1:] == seq_sorted[:-1])
+
+        # ==== buffer puts (one per consumed level, NFA.java:238-271) ========
+        put_flat = jnp.stack([v["c_m"] for v in levels], axis=1).reshape(-1)  # [R*L]
+        put_pos = state["node_count"] + _excl_cumsum(put_flat)
+        node_drop = put_flat & (put_pos >= B)
+        put_idx_flat = jnp.where(put_flat & ~node_drop, put_pos, B)
+        put_idx = put_idx_flat.reshape(R, L)
+        cs_mat = jnp.stack([v["cs"] for v in levels], axis=1)  # [R, L]
+        node_event = state["node_event"].at[put_idx_flat].set(
+            jnp.where(put_flat, gidx, -1), mode="drop"
+        )
+        node_name = state["node_name"].at[put_idx_flat].set(
+            jnp.where(put_flat, t_name_id[cs_mat.reshape(-1)], -1), mode="drop"
+        )
+        node_pred = state["node_pred"].at[put_idx_flat].set(
+            jnp.where(put_flat, jnp.repeat(lane_node, L), -1), mode="drop"
+        )
+        # Trash slot stays clean.
+        node_event = node_event.at[B].set(-1)
+        node_name = node_name.at[B].set(-1)
+        node_pred = node_pred.at[B].set(-1)
+        new_node_count = state["node_count"] + jnp.sum(put_flat & ~node_drop).astype(jnp.int32)
+
+        # ==== upward pass: clones / begin-re-adds (NFA.java:289-338) ========
+        desc_any = jnp.zeros(R, bool)
+        up: List[Optional[Dict[str, jnp.ndarray]]] = [None] * L
+        for l in reversed(range(L)):
+            v = levels[l]
+            ignore_emit = v["ig_m"] & ~v["branch_m"]
+            clone_m = v["branch_m"] & v["c_m"]
+            rootcopy_m = v["branch_m"] & ~v["c_m"] & ~desc_any
+            readd_cond = root_begin & ~root_fwd & v["alive"]
+            readd_fresh = readd_cond & v["c_m"]
+            readd_root = readd_cond & ~v["c_m"]
+            ns_before = v["c_m"] | ignore_emit | desc_any | clone_m | rootcopy_m
+            # Begin re-add version: bare when nothing else was emitted at this
+            # level, else addRun (NFA.java:323-331).
+            readd_ver = jnp.where(
+                (readd_fresh & ns_before)[:, None],
+                add_run(v["ver"], v["vlen"], jnp.ones(R, jnp.int32)),
+                v["ver"],
+            )
+            up[l] = dict(
+                ignore_emit=ignore_emit, clone_m=clone_m, rootcopy_m=rootcopy_m,
+                readd_fresh=readd_fresh, readd_root=readd_root, readd_ver=readd_ver,
+            )
+            desc_any = ns_before | readd_fresh | readd_root
+
+        # ==== output slot table in oracle DFS order =========================
+        # Downward: consume emit, ignore emit per level; upward: clone (or
+        # branch-root-re-add) then begin-re-add per level, deepest first.
+        zero_i = jnp.zeros(R, jnp.int32)
+        false_b = jnp.zeros(R, bool)
+
+        slot_occ, slot_src, slot_eps = [], [], []
+        slot_ver, slot_vlen, slot_seq = [], [], []
+        slot_node, slot_ts, slot_br, slot_ig = [], [], [], []
+        slot_newseq = []       # allocates a fresh run id
+        slot_regs, slot_regs_set = [], []
+
+        for l in range(L):
+            v = levels[l]
+            # consume emission: TAKE -> epsilon(self, self); BEGIN ->
+            # epsilon(self, target) (NFA.java:238-271).
+            c_eps = jnp.where(v["take_m"], v["cs"], t_consume_target[v["cs"]])
+            slot_occ.append(v["c_m"])
+            slot_src.append(v["cs"])
+            slot_eps.append(c_eps)
+            slot_ver.append(v["ver"])
+            slot_vlen.append(v["vlen"])
+            slot_seq.append(lane_seq)
+            slot_node.append(put_idx[:, l].astype(jnp.int32))
+            slot_ts.append(start_ts)
+            slot_br.append(false_b)
+            slot_ig.append(false_b)
+            slot_newseq.append(false_b)
+            slot_regs.append(final_regs)
+            slot_regs_set.append(final_set)
+
+            # ignore emission keeps the computation as-is with ignored=True
+            # (NFA.java:272-285).
+            i_src = jnp.where(jnp.asarray(l == 0), src, v["cs"])
+            i_eps = jnp.where(jnp.asarray(l == 0), eps, jnp.full(R, -1, jnp.int32))
+            slot_occ.append(up[l]["ignore_emit"])
+            slot_src.append(i_src)
+            slot_eps.append(i_eps)
+            slot_ver.append(v["ver"])
+            slot_vlen.append(v["vlen"])
+            slot_seq.append(lane_seq)
+            slot_node.append(lane_node)
+            slot_ts.append(lane_ts)
+            slot_br.append(false_b)
+            slot_ig.append(jnp.ones(R, bool))
+            slot_newseq.append(false_b)
+            slot_regs.append(final_regs)
+            slot_regs_set.append(final_set)
+
+        for l in reversed(range(L)):
+            v = levels[l]
+            u = up[l]
+            # branch clone: epsilon(prev, current), version addRun(2) off a
+            # begin previous stage else addRun(), last event = previous when
+            # ignored else current (NFA.java:289-307). A null previous stage
+            # parks the clone at the current stage (oracle divergence note,
+            # nfa/nfa.py:286-291).
+            has_ps = v["ps"] >= 0
+            cl_src = jnp.where(has_ps, v["ps"], v["cs"])
+            ps_begin = jnp.where(has_ps, t_is_begin[v["ps"].clip(0)], True)
+            off = jnp.where(ps_begin & (v["vlen"] >= 2), 2, 1).astype(jnp.int32)
+            cl_ver = add_run(v["ver"], v["vlen"], off)
+            cl_node = jnp.where(v["ig_m"], lane_node, put_idx[:, l].astype(jnp.int32))
+
+            m_clone = u["clone_m"]
+            m_copy = u["rootcopy_m"]
+            occ = m_clone | m_copy
+            slot_occ.append(occ)
+            slot_src.append(jnp.where(m_clone, cl_src, src))
+            slot_eps.append(jnp.where(m_clone, v["cs"], eps))
+            slot_ver.append(jnp.where(m_clone[:, None], cl_ver, state["ver"]))
+            slot_vlen.append(jnp.where(m_clone, v["vlen"], state["vlen"]))
+            slot_seq.append(jnp.where(m_clone, zero_i, lane_seq))  # fresh id patched below
+            slot_node.append(jnp.where(m_clone, cl_node, lane_node))
+            slot_ts.append(jnp.where(m_clone, start_ts, lane_ts))
+            slot_br.append(jnp.where(m_clone, True, state["branching"]))
+            slot_ig.append(jnp.where(m_clone, False, state["ignored"]))
+            slot_newseq.append(m_clone)
+            cr, cr_set = clone_regs[l]
+            slot_regs.append(jnp.where(m_clone[:, None], cr, final_regs))
+            slot_regs_set.append(jnp.where(m_clone[:, None], cr_set, final_set))
+
+            # begin re-add: fresh run on consume else the root itself
+            # (NFA.java:323-338).
+            m_fresh = u["readd_fresh"]
+            m_root = u["readd_root"]
+            occ = m_fresh | m_root
+            slot_occ.append(occ)
+            slot_src.append(src)
+            slot_eps.append(eps)
+            slot_ver.append(jnp.where(m_fresh[:, None], u["readd_ver"], state["ver"]))
+            slot_vlen.append(jnp.where(m_fresh, v["vlen"], state["vlen"]))
+            slot_seq.append(jnp.where(m_fresh, zero_i, lane_seq))
+            slot_node.append(jnp.where(m_fresh, -1, lane_node))
+            slot_ts.append(jnp.where(m_fresh, -1, lane_ts))
+            slot_br.append(jnp.where(m_fresh, False, state["branching"]))
+            slot_ig.append(jnp.where(m_fresh, False, state["ignored"]))
+            slot_newseq.append(m_fresh)
+            slot_regs.append(jnp.where(m_fresh[:, None], jnp.zeros_like(final_regs), final_regs))
+            slot_regs_set.append(
+                jnp.where(m_fresh[:, None], jnp.zeros_like(final_set), final_set)
+            )
+
+        occ = jnp.stack(slot_occ, axis=1)              # [R, SLOTS]
+        o_src = jnp.stack(slot_src, axis=1)
+        o_eps = jnp.stack(slot_eps, axis=1)
+        o_ver = jnp.stack(slot_ver, axis=1)            # [R, SLOTS, D]
+        o_vlen = jnp.stack(slot_vlen, axis=1)
+        o_seq = jnp.stack(slot_seq, axis=1)
+        o_node = jnp.stack(slot_node, axis=1)
+        o_ts = jnp.stack(slot_ts, axis=1)
+        o_br = jnp.stack(slot_br, axis=1)
+        o_ig = jnp.stack(slot_ig, axis=1)
+        o_newseq = jnp.stack(slot_newseq, axis=1)
+        o_regs = jnp.stack(slot_regs, axis=1)          # [R, SLOTS, A]
+        o_regs_set = jnp.stack(slot_regs_set, axis=1)
+
+        # Fresh run ids in (lane, slot) order = the oracle's queue-item-major
+        # DFS allocation order for the runs counter.
+        newseq_flat = (occ & o_newseq).reshape(-1)
+        seq_alloc = state["runs"] + 1 + _excl_cumsum(newseq_flat)
+        o_seq = jnp.where(
+            (occ & o_newseq).reshape(-1), seq_alloc, o_seq.reshape(-1)
+        ).reshape(R, SLOTS).astype(jnp.int32)
+        new_runs = state["runs"] + jnp.sum(newseq_flat).astype(jnp.int32)
+
+        # ==== match extraction (forwarding-to-final, NFA.java:148-158) ======
+        is_match = occ & (
+            ((o_eps >= 0) & t_is_final[o_eps.clip(0)])
+            | ((o_eps < 0) & t_fwd_final[o_src.clip(0)])
+        )
+        match_flat = is_match.reshape(-1)
+        mpos = state["match_count"] + _excl_cumsum(match_flat)
+        match_drop = match_flat & (mpos >= M)
+        midx = jnp.where(match_flat & ~match_drop, mpos, M)
+        match_node = state["match_node"].at[midx].set(
+            jnp.where(match_flat, o_node.reshape(-1), -1), mode="drop"
+        )
+        match_node = match_node.at[M].set(-1)
+        new_match_count = state["match_count"] + jnp.sum(match_flat & ~match_drop).astype(
+            jnp.int32
+        )
+
+        # ==== lane compaction (new queue in emission order) =================
+        keep = (occ & ~is_match).reshape(-1)
+        lpos = _excl_cumsum(keep)
+        lane_drop = keep & (lpos >= R)
+        lidx = jnp.where(keep & ~lane_drop, lpos, R)
+
+        def scat(flat_vals, fill, extra_dims=()):
+            out = jnp.full((R + 1,) + extra_dims, fill, flat_vals.dtype)
+            out = out.at[lidx].set(
+                jnp.where(
+                    keep.reshape((-1,) + (1,) * len(extra_dims)), flat_vals, fill
+                ),
+                mode="drop",
+            )
+            return out[:R]
+
+        n_active = scat(keep, False)
+        n_src = scat(o_src.reshape(-1), 0)
+        n_eps = scat(o_eps.reshape(-1), -1)
+        n_ver = scat(o_ver.reshape(-1, D), 0, (D,))
+        n_vlen = scat(o_vlen.reshape(-1), 0)
+        n_seq = scat(o_seq.reshape(-1), 0)
+        n_node = scat(o_node.reshape(-1), -1)
+        n_ts = scat(o_ts.reshape(-1), -1)
+        n_br = scat(o_br.reshape(-1), False)
+        n_ig = scat(o_ig.reshape(-1), False)
+        n_regs = scat(o_regs.reshape(-1, A), jnp.float32(0), (A,))
+        n_regs_set = scat(o_regs_set.reshape(-1, A), False, (A,))
+
+        new_state = {
+            "active": n_active, "src": n_src, "eps": n_eps, "ver": n_ver,
+            "vlen": n_vlen, "seq": n_seq, "node": n_node, "ts": n_ts,
+            "branching": n_br, "ignored": n_ig,
+            "regs": n_regs, "regs_set": n_regs_set,
+            "runs": new_runs,
+            "node_event": node_event, "node_name": node_name,
+            "node_pred": node_pred, "node_count": new_node_count,
+            "match_node": match_node, "match_count": new_match_count,
+            "n_events": state["n_events"] + 1,
+            "n_branches": state["n_branches"]
+            + jnp.sum(jnp.stack([u["clone_m"] for u in up if u is not None])).astype(jnp.int32),
+            "n_expired": state["n_expired"] + jnp.sum(expired).astype(jnp.int32),
+            "lane_drops": state["lane_drops"] + jnp.sum(lane_drop).astype(jnp.int32),
+            "node_drops": state["node_drops"] + jnp.sum(node_drop).astype(jnp.int32),
+            "match_drops": state["match_drops"] + jnp.sum(match_drop).astype(jnp.int32),
+            "seq_collisions": state["seq_collisions"] + collide.astype(jnp.int32),
+        }
+
+        # Padding lanes in a batched multi-key step carry valid=False.
+        valid = x["valid"]
+        merged = jax.tree.map(
+            lambda new, old: jnp.where(valid, new, old), new_state, state
+        )
+        return merged, None
+
+    return step
+
+
+def build_batch_fn(query: CompiledQuery, config: EngineConfig):
+    """jit-compiled batch advance: scan the one-event step over [T] columns.
+
+    `xs` is the packed batch: event columns ("f:*", "ts", "topic") of shape
+    [T], plus "spred" [T, P] (precomputed stateless predicate rows),
+    "gidx" [T] global event indices and "valid" [T].
+    """
+    step = build_step(query, config)
+
+    @jax.jit
+    def advance(state, xs):
+        state, _ = jax.lax.scan(step, state, xs)
+        return state
+
+    return advance
+
+
+def eval_stateless_preds(query: CompiledQuery, cols: Dict[str, np.ndarray]) -> jnp.ndarray:
+    """Evaluate all stateless predicates over the whole batch: one fused
+    vectorized pass per predicate (the [T, P] mask precompute)."""
+    T = len(cols["ts"])
+    env = DeviceEnv(
+        {k: jnp.asarray(v) for k, v in cols.items()},
+        jnp.zeros((1, query.n_aggs), jnp.float32),
+        jnp.zeros((1, query.n_aggs), bool),
+        query.agg_slots,
+        query.agg_defaults,
+    )
+    out = []
+    for p in range(max(query.n_preds, 1)):
+        if p < query.n_preds and not query.pred_stateful[p]:
+            v = jnp.broadcast_to(jnp.asarray(query.predicates[p](env), bool), (T,))
+        else:
+            v = jnp.zeros(T, bool)  # stateful: evaluated in-step per lane
+        out.append(v)
+    return jnp.stack(out, axis=1)

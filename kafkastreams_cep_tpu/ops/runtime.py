@@ -1,0 +1,186 @@
+"""Host runtime around the device engine: packing, decode, pool GC.
+
+The device kernel (ops/engine.py) runs the transition relation; this module
+owns everything that stays host-side in the TPU-native design
+(SURVEY.md section 7 build plan, steps 4-5):
+
+  * event ingestion: packing a micro-batch of `Event`s into SoA columns via
+    the query's EventSchema and keeping a (global index -> Event) registry
+    for match materialization;
+  * match construction: walking the device node pool's predecessor indices
+    backwards and assembling `Sequence` objects in the oracle's order
+    (the host analog of SharedVersionedBufferStoreImpl.peek,
+    reference: core/.../state/internal/SharedVersionedBufferStoreImpl.java:176-201);
+  * buffer GC: mark-sweep compaction of the node pool at batch boundaries,
+    replacing the reference's per-traversal refcount decrements
+    (the "deferred refcount deltas + periodic compaction" design,
+    SURVEY.md section 7 "Refcounted buffer GC without pointers").
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.event import Event
+from ..core.sequence import Sequence, SequenceBuilder
+from ..pattern.stages import Stages
+from .engine import EngineConfig, build_batch_fn, eval_stateless_preds, init_state
+from .schema import EventSchema
+from .tables import CompiledQuery, compile_query
+
+
+class DeviceNFA:
+    """Single-key device NFA: the accelerator counterpart of nfa/nfa.py.
+
+    Drives the jit-compiled scan batch-by-batch while keeping the run/buffer
+    state device-resident between batches; only match descriptors and (at GC
+    points) the node pool cross back to the host.
+    """
+
+    def __init__(
+        self,
+        stages_or_query: Any,
+        schema: Optional[EventSchema] = None,
+        config: Optional[EngineConfig] = None,
+    ) -> None:
+        if isinstance(stages_or_query, CompiledQuery):
+            self.query = stages_or_query
+        else:
+            assert isinstance(stages_or_query, Stages)
+            self.query = compile_query(stages_or_query, schema)
+        self.config = config if config is not None else EngineConfig()
+        self._advance = build_batch_fn(self.query, self.config)
+        self.state = init_state(self.query, self.config)
+        self._events: Dict[int, Event] = {}
+        self._next_gidx = 0
+        self._ts_base: Optional[int] = None
+
+    # ------------------------------------------------------------------ API
+    @property
+    def runs(self) -> int:
+        """Run counter -- parity with NFA.runs for conformance asserts."""
+        return int(self.state["runs"])
+
+    @property
+    def n_live(self) -> int:
+        """Live lane count -- parity with len(NFA.computation_stages)."""
+        return int(np.sum(np.asarray(self.state["active"])))
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        keys = (
+            "n_events", "n_branches", "n_expired",
+            "lane_drops", "node_drops", "match_drops", "seq_collisions",
+        )
+        return {k: int(self.state[k]) for k in keys}
+
+    def match_pattern(self, event: Event) -> List[Sequence]:
+        """Single-event convenience API mirroring NFA.match_pattern."""
+        return self.advance([event])
+
+    def advance(self, events: List[Event]) -> List[Sequence]:
+        """Process a micro-batch; returns completed matches in oracle order."""
+        if not events:
+            return []
+        xs = self._pack(events)
+        self.state = self._advance(self.state, xs)
+        matches = self._decode_matches()
+        self._compact()
+        return matches
+
+    # ------------------------------------------------------------ internals
+    def _pack(self, events: List[Event]) -> Dict[str, jnp.ndarray]:
+        if self._ts_base is None:
+            self._ts_base = int(events[0].timestamp)
+        schema = self.query.schema
+        cols = schema.pack(
+            [e.value for e in events],
+            [e.timestamp for e in events],
+            topics=[e.topic for e in events],
+            ts_base=self._ts_base,
+        )
+        T = len(events)
+        gidx = np.arange(self._next_gidx, self._next_gidx + T, dtype=np.int32)
+        for i, e in enumerate(events):
+            self._events[int(gidx[i])] = e
+        self._next_gidx += T
+        xs = {k: jnp.asarray(v) for k, v in cols.items()}
+        xs["spred"] = eval_stateless_preds(self.query, cols)
+        xs["gidx"] = jnp.asarray(gidx)
+        xs["valid"] = jnp.ones(T, bool)
+        return xs
+
+    def _decode_matches(self) -> List[Sequence]:
+        count = int(self.state["match_count"])
+        if count == 0:
+            return []
+        match_node = np.asarray(self.state["match_node"])[:count]
+        node_event = np.asarray(self.state["node_event"])
+        node_name = np.asarray(self.state["node_name"])
+        node_pred = np.asarray(self.state["node_pred"])
+        names = self.query.name_of_id
+
+        out: List[Sequence] = []
+        for node in match_node:
+            builder: SequenceBuilder = SequenceBuilder()
+            idx = int(node)
+            while idx >= 0:
+                builder.add(names[int(node_name[idx])], self._events[int(node_event[idx])])
+                idx = int(node_pred[idx])
+            out.append(builder.build(reversed_=True))
+
+        # Drain the ring.
+        self.state["match_count"] = jnp.asarray(0, np.int32)
+        self.state["match_node"] = jnp.full_like(self.state["match_node"], -1)
+        return out
+
+    def _compact(self) -> None:
+        """Mark-sweep the node pool: keep chains reachable from live lanes."""
+        count = int(self.state["node_count"])
+        if count == 0:
+            return
+        active = np.asarray(self.state["active"])
+        lane_node = np.asarray(self.state["node"])
+        node_pred = np.asarray(self.state["node_pred"])[: count]
+        node_event = np.asarray(self.state["node_event"])[: count]
+        node_name = np.asarray(self.state["node_name"])[: count]
+
+        marked = np.zeros(count, bool)
+        for i in range(len(active)):
+            if not active[i]:
+                continue
+            idx = int(lane_node[i])
+            while idx >= 0 and not marked[idx]:
+                marked[idx] = True
+                idx = int(node_pred[idx])
+        kept = np.flatnonzero(marked)
+        if len(kept) == count:
+            return
+        remap = np.full(count + 1, -1, np.int32)
+        remap[kept] = np.arange(len(kept), dtype=np.int32)
+
+        B = len(np.asarray(self.state["node_pred"])) - 1
+        new_event = np.full(B + 1, -1, np.int32)
+        new_name = np.full(B + 1, -1, np.int32)
+        new_pred = np.full(B + 1, -1, np.int32)
+        new_event[: len(kept)] = node_event[kept]
+        new_name[: len(kept)] = node_name[kept]
+        # Predecessors of kept nodes are kept too (chains are marked whole).
+        pred_of_kept = node_pred[kept]
+        new_pred[: len(kept)] = np.where(
+            pred_of_kept >= 0, remap[pred_of_kept.clip(0)], -1
+        )
+        new_lane_node = np.where(lane_node >= 0, remap[lane_node.clip(0, count)], -1)
+
+        self.state["node_event"] = jnp.asarray(new_event)
+        self.state["node_name"] = jnp.asarray(new_name)
+        self.state["node_pred"] = jnp.asarray(new_pred)
+        self.state["node_count"] = jnp.asarray(len(kept), np.int32)
+        self.state["node"] = jnp.asarray(new_lane_node.astype(np.int32))
+
+        # Prune the event registry to events still referenced by the pool.
+        live_gidx = set(int(g) for g in new_event[: len(kept)] if g >= 0)
+        self._events = {g: e for g, e in self._events.items() if g in live_gidx}

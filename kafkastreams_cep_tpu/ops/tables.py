@@ -136,10 +136,20 @@ class CompiledQuery:
     proceed_kind: np.ndarray    # PR_NONE | PR_PROCEED | PR_SKIP
     proceed_pred: np.ndarray
     proceed_target: np.ndarray
-    window_ms: np.ndarray       # i32, -1 none
+    window_ms: np.ndarray       # i64, -1 none (int64 so >24.8-day windows
+                                # don't overflow; see ADVICE r1)
     name_id: np.ndarray         # buffer-key identity (name, type) id
+    pure_name_id: np.ndarray    # name-only id (stage-cross detection,
+                                # NFA.java:343-349 compares getName())
     is_begin: np.ndarray        # bool
     is_final: np.ndarray        # bool
+    #: stage is a pure forwarder: single PROCEED edge
+    #: (ComputationStage.isForwarding, ComputationStage.java:134-140)
+    is_fwd: np.ndarray = dc_field(default_factory=lambda: np.zeros(0, bool))
+    #: forwarding stage whose PROCEED target is $final
+    fwd_final: np.ndarray = dc_field(default_factory=lambda: np.zeros(0, bool))
+    #: per-predicate: reads fold registers (must be evaluated per run lane)
+    pred_stateful: np.ndarray = dc_field(default_factory=lambda: np.zeros(0, bool))
 
     #: predicate closures: fn(DeviceEnv) -> bool array broadcast over runs
     predicates: List[Callable[[DeviceEnv], Any]] = dc_field(default_factory=list)
@@ -170,15 +180,18 @@ def compile_query(stages: Stages, schema: Optional[EventSchema] = None) -> Compi
     proceed_kind = np.zeros(n, np.int32)
     proceed_pred = np.full(n, -1, np.int32)
     proceed_target = np.full(n, -1, np.int32)
-    window_ms = np.full(n, -1, np.int32)
+    window_ms = np.full(n, -1, np.int64)
     name_id = np.zeros(n, np.int32)
+    pure_name_id = np.zeros(n, np.int32)
     is_begin = np.zeros(n, bool)
     is_final = np.zeros(n, bool)
 
     predicates: List[Callable] = []
+    pred_stateful: List[bool] = []
     pred_ids: Dict[int, int] = {}
     name_ids: Dict[Tuple[str, StateType], int] = {}
     name_of_id: List[str] = []
+    pure_name_ids: Dict[str, int] = {}
     agg_slots: Dict[str, int] = {}
     agg_defaults: Dict[str, float] = {}
     folds: List[List[Tuple[int, Callable]]] = [[] for _ in range(n)]
@@ -194,6 +207,7 @@ def compile_query(stages: Stages, schema: Optional[EventSchema] = None) -> Compi
                 "predicate is not device-compilable (closure-based); use "
                 "expression predicates (field()/agg()/value()) or the host path"
             )
+        stateful = bool(expr.aggs())
         expr = _encode_consts(expr, schema)
         pid = len(predicates)
 
@@ -201,6 +215,7 @@ def compile_query(stages: Stages, schema: Optional[EventSchema] = None) -> Compi
             return _e.evaluate(env)
 
         predicates.append(run)
+        pred_stateful.append(stateful)
         pred_ids[key] = pid
         return pid
 
@@ -211,6 +226,9 @@ def compile_query(stages: Stages, schema: Optional[EventSchema] = None) -> Compi
             name_ids[key] = len(name_of_id)
             name_of_id.append(stage.name)
         name_id[i] = name_ids[key]
+        if stage.name not in pure_name_ids:
+            pure_name_ids[stage.name] = len(pure_name_ids)
+        pure_name_id[i] = pure_name_ids[stage.name]
         window_ms[i] = stage.window_ms
         is_begin[i] = stage.is_begin
         is_final[i] = stage.is_final
@@ -251,12 +269,39 @@ def compile_query(stages: Stages, schema: Optional[EventSchema] = None) -> Compi
                 proceed_pred[i] = pred_id(edge.predicate)
                 proceed_target[i] = index_of[id(edge.target)]
 
+    # A stage is a pure forwarder iff its only edge is a PROCEED
+    # (ComputationStage.isForwarding); runtime epsilon states are forwarders
+    # by construction, so depth below bounds the live descent chain.
+    is_fwd = (
+        (consume_op == OP_NONE) & (ignore_pred < 0) & (proceed_kind == PR_PROCEED)
+    )
+    fwd_final = np.zeros(n, bool)
+    for i in range(n):
+        if is_fwd[i] and proceed_target[i] >= 0:
+            fwd_final[i] = bool(is_final[proceed_target[i]])
+
+    # Epsilon-descent unroll depth: 1 level for the run's own (possibly
+    # synthesized-epsilon) stage plus the longest static PROCEED/SKIP_PROCEED
+    # chain reachable from any stage (SURVEY.md section 7, "Recursive
+    # epsilon-evaluation": max depth is static).
+    chain = [0] * n
+    def _chain(i: int, seen: Tuple[int, ...] = ()) -> int:
+        if proceed_kind[i] == PR_NONE or proceed_target[i] < 0:
+            return 1
+        tgt = int(proceed_target[i])
+        if tgt in seen:  # defensive: construction rules never build cycles
+            return 1
+        return 1 + _chain(tgt, seen + (i,))
+    for i in range(n):
+        chain[i] = _chain(i)
+    max_depth = 1 + max(chain) if n else 1
+
     return CompiledQuery(
         schema=schema,
         n_stages=n,
         n_preds=len(predicates),
         n_aggs=max(1, len(agg_slots)),
-        max_depth=n,
+        max_depth=max_depth,
         consume_op=consume_op,
         consume_pred=consume_pred,
         consume_target=consume_target,
@@ -266,8 +311,12 @@ def compile_query(stages: Stages, schema: Optional[EventSchema] = None) -> Compi
         proceed_target=proceed_target,
         window_ms=window_ms,
         name_id=name_id,
+        pure_name_id=pure_name_id,
         is_begin=is_begin,
         is_final=is_final,
+        is_fwd=is_fwd,
+        fwd_final=fwd_final,
+        pred_stateful=np.asarray(pred_stateful, bool),
         predicates=predicates,
         folds=folds,
         agg_slots=agg_slots,
