@@ -81,6 +81,37 @@ class DeviceNFA:
         """Single-event convenience API mirroring NFA.match_pattern."""
         return self.advance([event])
 
+    def live_runs(self) -> List[Dict[str, Any]]:
+        """Queue snapshot in order: (stage name, run id, last event, version).
+
+        The device analog of inspecting NFA.computation_stages in tests
+        (reference: NFATest.assertNFA, NFATest.java:836-840).
+        """
+        active = np.asarray(self.state["active"])
+        src = np.asarray(self.state["src"])
+        seq = np.asarray(self.state["seq"])
+        node = np.asarray(self.state["node"])
+        ver = np.asarray(self.state["ver"])
+        vlen = np.asarray(self.state["vlen"])
+        node_event = np.asarray(self.state["node_event"])
+        out = []
+        for i in range(len(active)):
+            if not active[i]:
+                continue
+            name = self.query.name_of_id[int(self.query.name_id[src[i]])]
+            last = None
+            if node[i] >= 0:
+                last = self._events.get(int(node_event[node[i]]))
+            out.append(
+                dict(
+                    stage=name,
+                    sequence=int(seq[i]),
+                    last_event=last,
+                    version=".".join(str(d) for d in ver[i][: vlen[i]]),
+                )
+            )
+        return out
+
     def advance(self, events: List[Event]) -> List[Sequence]:
         """Process a micro-batch; returns completed matches in oracle order."""
         if not events:

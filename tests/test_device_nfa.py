@@ -1,0 +1,362 @@
+"""Device engine conformance: the oracle's scenarios through the TPU kernel.
+
+Re-runs the NFATest-derived scenarios (tests/test_nfa.py, reference:
+NFATest.java:47-874) through the jit-compiled device engine, with predicates
+re-expressed as device-compilable expression trees. Every scenario asserts
+
+  * identical matches (content and emission order),
+  * identical run counter (NFA.runs),
+  * identical live-queue length (and, where the reference asserts it,
+    identical queue shape: stage names / run ids / last events),
+
+against the host oracle driven on the same events. The sequence-matcher
+scenario (NFATest.java:111-157) is host-only by design -- arbitrary
+partial-match re-reads don't compile to the device; its fold-register
+equivalent is test_stateful_condition (SURVEY.md section 7,
+"SequenceMatcher semantics").
+"""
+import itertools
+
+import pytest
+
+from kafkastreams_cep_tpu import (
+    AggregatesStore,
+    Event,
+    NFA,
+    QueryBuilder,
+    Selected,
+    SharedVersionedBuffer,
+    compile_pattern,
+)
+from kafkastreams_cep_tpu.ops.engine import EngineConfig
+from kafkastreams_cep_tpu.ops.runtime import DeviceNFA
+from kafkastreams_cep_tpu.pattern.expressions import agg, value
+
+TS = 1_000_000
+ev1 = Event("ev1", "A", TS, "test", 0, 0)
+ev2 = Event("ev2", "B", TS, "test", 0, 1)
+ev3 = Event("ev3", "C", TS, "test", 0, 2)
+ev4 = Event("ev4", "C", TS, "test", 0, 3)
+ev5 = Event("ev5", "D", TS, "test", 0, 4)
+ev6 = Event("ev6", "C", TS, "test", 0, 5)
+ev7 = Event("ev7", "D", TS, "test", 0, 6)
+ev8 = Event("ev8", "E", TS, "test", 0, 7)
+
+CONFIG = EngineConfig(lanes=16, nodes=512, matches=64)
+
+_offset = itertools.count()
+
+
+def next_event(key, val, topic="t1"):
+    return Event(key, val, TS, topic, 0, next(_offset))
+
+
+def run_both(pattern, events, batch_sizes=(0,)):
+    """Drive oracle + device on the same events; assert full parity.
+
+    batch_sizes: 0 = whole stream in one device micro-batch; also re-checks
+    with the given batch splits to prove batch boundaries are invisible.
+    """
+    stages = compile_pattern(pattern)
+    oracle = NFA.build(stages, AggregatesStore(), SharedVersionedBuffer())
+    oracle_matches = []
+    for e in events:
+        oracle_matches.extend(oracle.match_pattern(e))
+
+    results = []
+    for bs in batch_sizes:
+        dev = DeviceNFA(compile_pattern(pattern), config=CONFIG)
+        dev_matches = []
+        if bs <= 0:
+            dev_matches = dev.advance(list(events))
+        else:
+            for i in range(0, len(events), bs):
+                dev_matches.extend(dev.advance(list(events[i : i + bs])))
+        assert dev_matches == oracle_matches, f"matches diverge (batch={bs})"
+        assert dev.runs == oracle.runs, f"runs diverge (batch={bs})"
+        assert dev.n_live == len(oracle.computation_stages), f"queue diverges (batch={bs})"
+        results.append((dev, dev_matches))
+    return oracle, results[0][0], results[0][1]
+
+
+def test_stateful_condition():
+    """Fold registers drive stage predicates (NFATest.java:66-109)."""
+    pattern = (
+        QueryBuilder()
+        .select("first")
+        .where(value() > 0)
+        .fold("sum", value())
+        .fold("count", 1 + (agg("sum") - agg("sum")))  # constant 1 expression
+        .then()
+        .select("second")
+        .one_or_more()
+        .where((agg("sum") // agg("count")) >= value())
+        .fold("sum", agg("sum") + value())
+        .fold("count", agg("count") + 1)
+        .then()
+        .select("latest")
+        .where((agg("sum") // agg("count")) < value())
+        .build()
+    )
+    e1 = next_event("key", 5)
+    e2 = next_event("key", 3)
+    e3 = next_event("key", 4)
+    e4 = next_event("key", 10)
+    oracle, dev, matches = run_both(pattern, [e1, e2, e3, e4], batch_sizes=(0, 1, 2))
+    assert len(matches) == 1
+    assert [e.value for e in matches[0]] == [5, 3, 4, 10]
+
+
+def test_times_occurrences():
+    """Pattern (A; C{3}; E) over A1 C3 C4 C6 E8 (NFATest.java:159-196)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second").times(3).where(value() == "C")
+        .then()
+        .select("latest").where(value() == "E")
+        .build()
+    )
+    oracle, dev, matches = run_both(pattern, [ev1, ev3, ev4, ev6, ev8], batch_sizes=(0, 2))
+    assert len(matches) == 1
+
+
+def test_zero_or_more_no_matching_inputs():
+    """Pattern (A; C*; D) over A1 D5 (NFATest.java:198-232)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second").zero_or_more().where(value() == "C")
+        .then()
+        .select("latest").where(value() == "D")
+        .build()
+    )
+    oracle, dev, matches = run_both(pattern, [ev1, ev5])
+    assert len(matches) == 1
+
+
+def test_zero_or_more_matching_inputs():
+    """Pattern (A; C*; D) over A1 C3 C4 D5 (NFATest.java:234-270)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second").zero_or_more().where(value() == "C")
+        .then()
+        .select("latest").where(value() == "D")
+        .build()
+    )
+    oracle, dev, matches = run_both(pattern, [ev1, ev3, ev4, ev5], batch_sizes=(0, 1))
+    assert len(matches) == 1
+
+
+def test_optional_times_no_matching_inputs():
+    """Pattern (A; C{2}?; D) over A1 D5 (NFATest.java:272-307)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second").times(2).optional().where(value() == "C")
+        .then()
+        .select("latest").where(value() == "D")
+        .build()
+    )
+    run_both(pattern, [ev1, ev5])
+
+
+def test_optional_times_matching_inputs():
+    """Pattern (A; C{2}?; D) over A1 C3 C4 D5 (NFATest.java:309-346)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second").times(2).optional().where(value() == "C")
+        .then()
+        .select("latest").where(value() == "D")
+        .build()
+    )
+    run_both(pattern, [ev1, ev3, ev4, ev5], batch_sizes=(0, 3))
+
+
+def test_times_skip_til_next_match():
+    """Pattern (A; C{3} skip-next; E) over A1 C3 C4 D5 C6 E8 (NFATest.java:348-385)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second", Selected.with_skip_til_next_match()).times(3).where(value() == "C")
+        .then()
+        .select("latest").where(value() == "E")
+        .build()
+    )
+    run_both(pattern, [ev1, ev3, ev4, ev5, ev6, ev8])
+
+
+def test_optional_stage_strict_contiguity():
+    """Pattern (A; B?; C) over A1 C3 (NFATest.java:387-421)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second").optional().where(value() == "B")
+        .then()
+        .select("latest").where(value() == "C")
+        .build()
+    )
+    run_both(pattern, [ev1, ev3])
+
+
+def test_one_run_strict_contiguity():
+    """Pattern (A; B; C) over A1 B2 C3 (NFATest.java:423-457)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second").where(value() == "B")
+        .then()
+        .select("latest").where(value() == "C")
+        .build()
+    )
+    run_both(pattern, [ev1, ev2, ev3], batch_sizes=(0, 1))
+
+
+def test_one_run_multiple_match():
+    """Pattern (A; B; C+; D) over A1 B2 C3 C4 D5 (NFATest.java:459-498)."""
+    pattern = (
+        QueryBuilder()
+        .select("firstStage").where(value() == "A")
+        .then()
+        .select("secondStage").where(value() == "B")
+        .then()
+        .select("thirdStage").one_or_more().where(value() == "C")
+        .then()
+        .select("latestState").where(value() == "D")
+        .build()
+    )
+    run_both(pattern, [ev1, ev2, ev3, ev4, ev5])
+
+
+def test_two_consecutive_skip_til_next_match():
+    """Pattern (A; C; D) skip-next over A1 B2 C3 C4 D5 (NFATest.java:500-532)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second", Selected.with_skip_til_next_match()).where(value() == "C")
+        .then()
+        .select("latest", Selected.with_skip_til_next_match()).where(value() == "D")
+        .build()
+    )
+    run_both(pattern, [ev1, ev2, ev3, ev4, ev5])
+
+
+def test_two_consecutive_skip_til_next_match_and_multiple_match():
+    """Pattern (A; C+; D) skip-next over A1 B2 C3 C4 D5 (NFATest.java:534-567)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second", Selected.with_skip_til_next_match()).one_or_more().where(value() == "C")
+        .then()
+        .select("latest", Selected.with_skip_til_next_match()).where(value() == "D")
+        .build()
+    )
+    run_both(pattern, [ev1, ev2, ev3, ev4, ev5], batch_sizes=(0, 2))
+
+
+def test_two_consecutive_skip_til_any_match():
+    """Pattern (A; C; D) skip-any: 2 matches, 6 runs, 4 live (NFATest.java:569-615)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second", Selected.with_skip_til_any_match()).where(value() == "C")
+        .then()
+        .select("latest", Selected.with_skip_til_any_match()).where(value() == "D")
+        .build()
+    )
+    oracle, dev, matches = run_both(pattern, [ev1, ev2, ev3, ev4, ev5], batch_sizes=(0, 1))
+    assert dev.runs == 6
+    assert dev.n_live == 4
+    assert len(matches) == 2
+
+
+def test_multiple_match_and_skip_til_any_match():
+    """Pattern (A; C+ skip-any; D): 3 matches, 5 runs, 2 live (NFATest.java:617-672)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second", Selected.with_skip_til_any_match()).one_or_more().where(value() == "C")
+        .then()
+        .select("latest").where(value() == "D")
+        .build()
+    )
+    oracle, dev, matches = run_both(pattern, [ev1, ev2, ev3, ev4, ev5], batch_sizes=(0, 2))
+    assert dev.runs == 5
+    assert dev.n_live == 2
+    assert len(matches) == 3
+
+
+def test_four_stage_two_consecutive_skip_til_any_match():
+    """Pattern (A; B; C skip-any; D skip-any): 2 matches, 6 runs, 4 live
+    (NFATest.java:674-724)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second").where(value() == "B")
+        .then()
+        .select("three", Selected.with_skip_til_any_match()).where(value() == "C")
+        .then()
+        .select("latest", Selected.with_skip_til_any_match()).where(value() == "D")
+        .build()
+    )
+    oracle, dev, matches = run_both(pattern, [ev1, ev2, ev3, ev4, ev5])
+    assert dev.runs == 6 and dev.n_live == 4 and len(matches) == 2
+
+
+def test_multiple_strategies():
+    """Pattern (A; B; C skip-any; D skip-next): 2 matches, 4 runs, 2 live
+    (NFATest.java:726-772)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second").where(value() == "B")
+        .then()
+        .select("three", Selected.with_skip_til_any_match()).where(value() == "C")
+        .then()
+        .select("latest", Selected.with_skip_til_next_match()).where(value() == "D")
+        .build()
+    )
+    oracle, dev, matches = run_both(pattern, [ev1, ev2, ev3, ev4, ev5])
+    assert dev.runs == 4 and dev.n_live == 2 and len(matches) == 2
+
+
+def test_skip_til_any_match_on_latest_stage():
+    """Pattern (A; B; C; D skip-any): queue-shape parity (NFATest.java:774-834)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("second").where(value() == "B")
+        .then()
+        .select("three").where(value() == "C")
+        .then()
+        .select("latest", Selected.with_skip_til_any_match()).where(value() == "D")
+        .build()
+    )
+    oracle, dev, matches = run_both(pattern, [ev1, ev2, ev3, ev5, ev7])
+    assert dev.runs == 4
+    live = dev.live_runs()
+    assert len(live) == 2
+    assert live[0]["stage"] == "three"
+    assert live[0]["sequence"] == 4
+    assert live[0]["last_event"] == ev3
+    assert live[1]["stage"] == "first"
+    assert live[1]["sequence"] == 2
+    assert live[1]["last_event"] is None
+    assert len(matches) == 2
