@@ -1,0 +1,101 @@
+"""Key-axis data parallelism: vmap over key lanes, pjit over the device mesh.
+
+The reference's only parallelism mechanism is Kafka partitioning: one stream
+task per partition, one NFA per record key inside a task
+(reference: core/.../cep/processor/CEPProcessor.java:111-124,139; SURVEY.md
+section 2.8). The TPU-native equivalent is a *batched* engine: the one-event
+transition kernel (ops/engine.py) is vmapped over a leading key axis, so one
+chip advances thousands of independent per-key NFAs in lockstep, and the key
+axis is sharded across a `jax.sharding.Mesh` for multi-chip scale-out.
+
+Collectives stay off the per-event hot path (per-key state never crosses
+chips for a single query); only the observability reduction
+(`global_stats`) and any key re-sharding ride ICI -- the design stance of
+SURVEY.md section 2.8/5.8.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.engine import EngineConfig, build_step, init_state
+from ..ops.tables import CompiledQuery
+
+#: Mesh axis name for the key shard (data-parallel axis).
+KEY_AXIS = "keys"
+
+
+def init_batched_state(
+    query: CompiledQuery, config: EngineConfig, n_keys: int
+) -> Dict[str, jnp.ndarray]:
+    """Per-key engine state stacked along a leading [K] axis."""
+    single = init_state(query, config)
+    return jax.tree.map(
+        lambda leaf: jnp.broadcast_to(leaf[None, ...], (n_keys,) + leaf.shape).copy(),
+        single,
+    )
+
+
+def build_batched_advance(query: CompiledQuery, config: EngineConfig):
+    """jit-compiled multi-key batch advance.
+
+    xs leaves are time-major [T, K, ...]: the scan walks events in lockstep
+    across keys (each key sees its own column slice; padding steps carry
+    valid=False). Returns the new [K]-stacked state.
+    """
+    step = build_step(query, config)
+    vstep = jax.vmap(step, in_axes=(0, 0))
+
+    @jax.jit
+    def advance(state, xs):
+        def body(carry, x):
+            new, _ = vstep(carry, x)
+            return new, None
+
+        state, _ = jax.lax.scan(body, state, xs)
+        return state
+
+    return advance
+
+
+def key_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D device mesh over the key axis."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (KEY_AXIS,))
+
+
+def key_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading key axis; everything else replicated per shard."""
+    return NamedSharding(mesh, P(KEY_AXIS))
+
+
+def shard_state(state: Dict[str, jnp.ndarray], mesh: Mesh) -> Dict[str, jnp.ndarray]:
+    sharding = key_sharding(mesh)
+    return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), state)
+
+
+def shard_xs(xs: Dict[str, jnp.ndarray], mesh: Mesh) -> Dict[str, jnp.ndarray]:
+    """Time-major xs: shard axis 1 (keys), replicate time."""
+    sharding = NamedSharding(mesh, P(None, KEY_AXIS))
+    return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), xs)
+
+
+def global_stats(state: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Cross-key counter reduction -- the one collective in the system.
+
+    Under a sharded key axis XLA lowers these sums to an all-reduce over ICI
+    (SURVEY.md section 5.5 observability counters).
+    """
+    keys = (
+        "n_events", "n_branches", "n_expired",
+        "lane_drops", "node_drops", "match_drops", "seq_collisions",
+        "match_count", "runs",
+    )
+    return {k: jnp.sum(state[k]) for k in keys}

