@@ -28,6 +28,7 @@ class MatcherContext:
         "previous_event",
         "current_event",
         "states",
+        "previous_key",
     )
 
     def __init__(
@@ -39,6 +40,7 @@ class MatcherContext:
         previous_event: Optional[Event],
         current_event: Event,
         states: States,
+        previous_key: Optional[Matched] = None,
     ) -> None:
         self.buffer = buffer
         self.version = version
@@ -47,19 +49,23 @@ class MatcherContext:
         self.previous_event = previous_event
         self.current_event = current_event
         self.states = states
+        self.previous_key = previous_key
 
     def partial_sequence(self) -> Sequence:
         """Materialize the partial match for sequence predicates.
 
         Mirrors SequenceMatcher's default accept (SequenceMatcher.java:22-26):
-        reads the buffer from the previous (stage, event) along the current
-        version.
+        reads the buffer from the run's last stored node along the current
+        version (by recorded key -- see ComputationStage.last_key -- with the
+        reference's (previousStage, previousEvent) reconstruction as
+        fallback).
         """
-        if self.previous_stage is None or self.previous_event is None:
-            return Sequence([])
-        return self.buffer.get(
-            Matched.from_parts(self.previous_stage, self.previous_event), self.version
-        )
+        key = self.previous_key
+        if key is None:
+            if self.previous_stage is None or self.previous_event is None:
+                return Sequence([])
+            key = Matched.from_parts(self.previous_stage, self.previous_event)
+        return self.buffer.get(key, self.version)
 
     def env(self) -> "HostEventEnv":
         return HostEventEnv(self.current_event, self.states)

@@ -38,7 +38,7 @@ from ..core.event import Event
 from ..core.sequence import Sequence
 from ..pattern.stages import Edge, EdgeOperation, Stage, Stages
 from ..state.aggregates import AggregatesStore, States
-from ..state.buffer import Matched, ReadOnlySharedVersionBuffer, SharedVersionedBuffer
+from ..state.buffer import LineageBuffer
 from .context import FoldEnv, MatcherContext
 
 K = TypeVar("K")
@@ -56,10 +56,24 @@ class ComputationStage(Generic[K, V]):
     timestamp: int = -1
     is_branching: bool = False
     is_ignored: bool = False
+    #: exact buffer key the run's last consumed event was stored under.
+    #: Deliberate divergence: the reference reconstructs this key from
+    #: (previousStage, previousEvent) at put time (NFA.java:351-360), which
+    #: breaks when the storing stage and the descent's previous stage carry
+    #: different StateTypes -- e.g. one_or_more on the first pattern stores
+    #: under (name, BEGIN) via the internal begin stage but looks up
+    #: (name, NORMAL) via the TAKE stage when the successor matches with zero
+    #: takes, so the reference throws IllegalStateException
+    #: ("Cannot find predecessor event"). Tracking the key explicitly is the
+    #: host analog of the device engine's per-lane last-node *index*.
+    last_key: Optional[Matched] = None
 
     def with_version(self, version: DeweyVersion) -> "ComputationStage[K, V]":
         # Mirrors ComputationStage.setVersion: branching/ignored flags reset.
-        return ComputationStage(self.stage, version, self.sequence, self.last_event, self.timestamp)
+        return ComputationStage(
+            self.stage, version, self.sequence, self.last_event, self.timestamp,
+            last_key=self.last_key,
+        )
 
     @property
     def is_begin_state(self) -> bool:
@@ -134,20 +148,12 @@ class NFA(Generic[K, V]):
     def _match_construction(
         self, states: List[ComputationStage[K, V]]
     ) -> List[Sequence[K, V]]:
-        return [
-            self.buffer.remove(
-                Matched.from_parts(c.stage, c.last_event), c.version
-            )
-            for c in states
-        ]
+        return [self.buffer.remove(c.last_key, c.version) for c in states]
 
     def _remove_pattern(self, computation: ComputationStage[K, V]) -> None:
-        if computation.last_event is None:
+        if computation.last_key is None:
             return
-        self.buffer.remove(
-            Matched.from_parts(computation.stage, computation.last_event),
-            computation.version,
-        )
+        self.buffer.remove(computation.last_key, computation.version)
 
     def _match_computation(
         self, computation: ComputationStage[K, V], event: Event[K, V]
@@ -164,6 +170,7 @@ class NFA(Generic[K, V]):
         sequence: int,
         previous_stage: Optional[Stage],
         current_stage: Stage,
+        previous_key: Optional[Matched] = None,
     ) -> List[Edge]:
         states = States(self.aggregates_store, current_event.key, sequence)
         read_only = ReadOnlySharedVersionBuffer(self.buffer)
@@ -175,6 +182,7 @@ class NFA(Generic[K, V]):
             previous_event=previous_event,
             current_event=current_event,
             states=states,
+            previous_key=previous_key,
         )
         return [e for e in current_stage.edges if e.predicate.accept(MatcherContext(**ctx_args))]
 
@@ -207,10 +215,12 @@ class NFA(Generic[K, V]):
 
         sequence_id = computation.sequence
         previous_event = computation.last_event
+        previous_key = computation.last_key
         version = computation.version
 
         matched_edges = self._matched_edges(
-            previous_event, event, version, sequence_id, previous_stage, current_stage
+            previous_event, event, version, sequence_id, previous_stage, current_stage,
+            previous_key,
         )
         operations = [e.operation for e in matched_edges]
         is_branching = self._is_branching(operations)
@@ -241,6 +251,7 @@ class NFA(Generic[K, V]):
 
             elif op == EdgeOperation.TAKE:
                 # Consume on the self loop: the run stays at this stage.
+                consumed_key = Matched.from_parts(current_stage, event)
                 next_stages.append(
                     ComputationStage(
                         stage=Stage.new_epsilon(current_stage, current_stage),
@@ -248,18 +259,20 @@ class NFA(Generic[K, V]):
                         sequence=sequence_id,
                         last_event=event,
                         timestamp=start_time,
+                        last_key=consumed_key,
                     )
                 )
                 if not is_branching or ignored:
-                    self._put_to_buffer(current_stage, previous_stage, previous_event, event, version)
+                    self._put_to_buffer(current_stage, previous_key, event, version)
                 else:
                     self._put_to_buffer(
-                        current_stage, previous_stage, previous_event, event, version.add_run()
+                        current_stage, previous_key, event, version.add_run()
                     )
                 consumed = True
 
             elif op == EdgeOperation.BEGIN:
-                self._put_to_buffer(current_stage, previous_stage, previous_event, event, version)
+                consumed_key = Matched.from_parts(current_stage, event)
+                self._put_to_buffer(current_stage, previous_key, event, version)
                 next_stages.append(
                     ComputationStage(
                         stage=Stage.new_epsilon(current_stage, edge.target),
@@ -267,6 +280,7 @@ class NFA(Generic[K, V]):
                         sequence=sequence_id,
                         last_event=event,
                         timestamp=start_time,
+                        last_key=consumed_key,
                     )
                 )
                 consumed = True
@@ -291,6 +305,9 @@ class NFA(Generic[K, V]):
                     prev_is_begin = True
                 run_offset = 2 if (prev_is_begin and len(version.digits) >= 2) else 1
                 next_version = version.add_run(run_offset)
+                clone_key = (
+                    previous_key if ignored else Matched.from_parts(current_stage, event)
+                )
                 next_stages.append(
                     ComputationStage(
                         stage=branch_stage,
@@ -299,12 +316,23 @@ class NFA(Generic[K, V]):
                         last_event=last_event,
                         timestamp=start_time,
                         is_branching=True,
+                        last_key=clone_key,
                     )
                 )
                 for agg_name in self.aggregates_names:
                     self.aggregates_store.branch(event.key, agg_name, sequence_id, new_sequence)
-                if previous_stage is not None and not previous_stage.is_begin:
-                    self.buffer.branch(previous_stage, previous_event, version)
+                # Pin the clone's shared chain. Deliberate divergence: the
+                # reference skips branch() off a begin previous stage
+                # (NFA.java:311-313), leaving the shared begin-rooted node
+                # unpinned -- if the sibling run dies first, its removal
+                # deletes the shared node and the reference then throws
+                # IllegalStateException("Cannot find predecessor event",
+                # SharedVersionedBufferStoreImpl.java:113-115) or silently
+                # truncates matches. Pinning every shared chain keeps the
+                # buffer sound; the device engine is immune by construction
+                # (index-linked chains + mark-sweep GC, no refcounts).
+                if previous_key is not None:
+                    self.buffer.branch_from(previous_key, version)
             elif not proceed:
                 next_stages.append(root)
 
@@ -341,15 +369,19 @@ class NFA(Generic[K, V]):
     def _put_to_buffer(
         self,
         current_stage: Stage,
-        previous_stage: Optional[Stage],
-        previous_event: Optional[Event[K, V]],
+        previous_key: Optional[Matched],
         event: Event[K, V],
         version: DeweyVersion,
     ) -> None:
-        if previous_stage is not None:
-            self.buffer.put(current_stage, event, previous_stage, previous_event, version)
-        else:
-            self.buffer.put(current_stage, event, version=version)
+        """Append the consumed event, chained to the run's last stored node.
+
+        Root put when the run has no predecessor node (fresh runs and clones
+        parked by begin-state branching). Linking by the run's recorded
+        last_key -- not by reconstructing a key from (previousStage,
+        previousEvent) as the reference does (NFA.java:351-360) -- is what
+        keeps the chain sound; see ComputationStage.last_key.
+        """
+        self.buffer.put_keyed(current_stage, event, previous_key, version)
 
     def _evaluate_aggregates(self, stage: Stage, sequence: int, event: Event[K, V]) -> None:
         for aggregator in stage.aggregates:

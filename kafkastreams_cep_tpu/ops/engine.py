@@ -140,8 +140,8 @@ def _excl_cumsum(mask: jnp.ndarray) -> jnp.ndarray:
 
 
 def build_step(
-    query: CompiledQuery, config: EngineConfig
-) -> Callable[[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]], Tuple[Dict[str, jnp.ndarray], None]]:
+    query: CompiledQuery, config: EngineConfig, debug: bool = False
+) -> Callable[[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]], Tuple[Dict[str, jnp.ndarray], Any]]:
     """Build the one-event transition function (a `lax.scan` body).
 
     The returned `step(state, x)` consumes one packed event
@@ -401,13 +401,16 @@ def build_step(
             slot_regs.append(final_regs)
             slot_regs_set.append(final_set)
 
-            # ignore emission keeps the computation as-is with ignored=True
-            # (NFA.java:272-285).
-            i_src = jnp.where(jnp.asarray(l == 0), src, v["cs"])
-            i_eps = jnp.where(jnp.asarray(l == 0), eps, jnp.full(R, -1, jnp.int32))
+            # ignore emission keeps the computation as-is with ignored=True:
+            # ROOT stage identity at any descent depth
+            # (NFA.java:272-285 re-adds ctx.getComputationStage().getStage(),
+            # i.e. the queue item's own -- possibly synthesized-epsilon --
+            # stage, never the descended stage; rewriting identity here both
+            # skips the epsilon hop and re-attaches the descended stage's
+            # window to a run the oracle never expires).
             slot_occ.append(up[l]["ignore_emit"])
-            slot_src.append(i_src)
-            slot_eps.append(i_eps)
+            slot_src.append(src)
+            slot_eps.append(eps)
             slot_ver.append(v["ver"])
             slot_vlen.append(v["vlen"])
             slot_seq.append(lane_seq)
@@ -566,6 +569,16 @@ def build_step(
         merged = jax.tree.map(
             lambda new, old: jnp.where(valid, new, old), new_state, state
         )
+        if debug:
+            dbg = dict(
+                occ=occ, o_src=o_src, o_eps=o_eps, o_seq=o_seq, o_node=o_node,
+                is_match=is_match, expired=expired,
+                levels=[
+                    {k: v for k, v in lv.items()} for lv in levels
+                ],
+                up=[{k: v for k, v in u.items()} for u in up],
+            )
+            return merged, dbg
         return merged, None
 
     return step

@@ -88,7 +88,13 @@ class DeviceEnv(Env):
     def agg(self, name: str, default: Any = None) -> Any:
         import jax.numpy as jnp
 
-        slot = self._agg_slots[name]
+        slot = self._agg_slots.get(name)
+        if slot is None:
+            # No fold ever writes this register: the host oracle's store
+            # lookup always misses and yields the default (States.getOrElse,
+            # state/States.java:70-73), so the device reads a constant.
+            fallback = default if default is not None else self._defaults.get(name, 0)
+            return jnp.asarray(fallback, jnp.float32)
         val = self._regs[..., slot]
         is_set = self._regs_set[..., slot]
         fallback = default if default is not None else self._defaults.get(name, 0)

@@ -118,11 +118,22 @@ class SharedVersionedBuffer(Generic[K, V]):
         """Append an event; with a predecessor, link a version-tagged pointer."""
         assert version is not None
         if prev_stage is None:
-            # Root put: new node with a null-predecessor pointer recording the
-            # version (the run) it belongs to.
-            node = BufferNode(curr_event.key, curr_event.value, curr_event.timestamp)
+            # Root put: a null-predecessor pointer records the version (run)
+            # it belongs to. Deliberate divergence: the reference always
+            # creates a fresh node here ("can only be added once",
+            # SharedVersionedBufferStoreImpl.java:149-157), which CLOBBERS the
+            # pointer list when another run already shares the same
+            # (stage, event) node -- reachable via an optional stage's
+            # SKIP_PROCEED when the successor event also completes non-skipped
+            # runs, truncating their extracted matches. Load-or-create keeps
+            # the buffer sound; the device engine is immune (per-run chain
+            # indices, no keyed store).
+            curr_key = Matched.from_parts(curr_stage, curr_event)
+            node = self._store.get(curr_key)
+            if node is None:
+                node = BufferNode(curr_event.key, curr_event.value, curr_event.timestamp)
             node.add_predecessor(version, None)
-            self._store[Matched.from_parts(curr_stage, curr_event)] = node
+            self._store[curr_key] = node
             return
 
         prev_key = Matched.from_parts(prev_stage, prev_event)
@@ -137,9 +148,40 @@ class SharedVersionedBuffer(Generic[K, V]):
         node.add_predecessor(version, prev_key)
         self._store[curr_key] = node
 
+    def put_keyed(
+        self,
+        curr_stage: Stage,
+        curr_event: Event[K, V],
+        prev_key: Optional[Matched],
+        version: DeweyVersion,
+    ) -> None:
+        """Append an event chained to an exact predecessor node key.
+
+        The NFA runtime records each run's last stored node key
+        (ComputationStage.last_key) and links through it, avoiding the
+        reference's key reconstruction from (previousStage, previousEvent)
+        (NFA.java:351-360) whose StateType can disagree with the storing
+        stage's.
+        """
+        if prev_key is None:
+            self.put(curr_stage, curr_event, version=version)
+            return
+        if prev_key not in self._store:
+            raise ValueError(f"Cannot find predecessor event for {prev_key}")
+        curr_key = Matched.from_parts(curr_stage, curr_event)
+        node = self._store.get(curr_key)
+        if node is None:
+            node = BufferNode(curr_event.key, curr_event.value, curr_event.timestamp)
+        node.add_predecessor(version, prev_key)
+        self._store[curr_key] = node
+
     def branch(self, stage: Stage, event: Event[K, V], version: DeweyVersion) -> None:
         """Increment refcounts along the predecessor chain of a new branch."""
-        pointer: Optional[Pointer] = Pointer(version, Matched.from_parts(stage, event))
+        self.branch_from(Matched.from_parts(stage, event), version)
+
+    def branch_from(self, key: Matched, version: DeweyVersion) -> None:
+        """branch() by exact node key (see put_keyed)."""
+        pointer: Optional[Pointer] = Pointer(version, key)
         while pointer is not None and pointer.key is not None:
             node = self._store[pointer.key]
             node.refs += 1
@@ -158,6 +200,19 @@ class SharedVersionedBuffer(Generic[K, V]):
     def _peek(
         self, matched: Matched, version: DeweyVersion, remove: bool, decrement: bool = True
     ) -> Sequence[K, V]:
+        """Walk the version-routed chain; with remove=True, GC unshared nodes.
+
+        Refcount discipline is reference-exact
+        (SharedVersionedBufferStoreImpl.java:176-201): the decrement happens
+        on a throwaway copy and is PERSISTED only on the refs_left==0
+        write-back path, so a node whose stored refcount is >=2 (pinned by
+        branch()) is never deleted -- shared chains are immortal. This leak
+        is deliberate: persisting every decrement instead (as an earlier
+        revision did) deletes nodes still referenced by live runs whenever
+        two matches extract through a shared prefix while an ignore-re-added
+        run retains it, and later puts then fail. The device engine has
+        neither problem (mark-sweep GC over per-lane chain indices).
+        """
         pointer: Optional[Pointer] = Pointer(version, matched)
         builder: SequenceBuilder[K, V] = SequenceBuilder()
 
@@ -166,7 +221,7 @@ class SharedVersionedBuffer(Generic[K, V]):
             node = self._store.get(key)
             if node is None:
                 break
-            refs_left = node.decrement_ref() if decrement else node.refs
+            refs_left = max(0, node.refs - 1) if decrement else node.refs
             if remove and refs_left == 0 and len(node.predecessors) <= 1:
                 del self._store[key]
 
@@ -176,11 +231,13 @@ class SharedVersionedBuffer(Generic[K, V]):
             )
             pointer = node.pointer_by_version(pointer.version)
             if remove and pointer is not None and refs_left == 0:
-                # Prune the traversed pointer and write the node back -- even
-                # if it was just deleted above. Deletion only sticks for the
-                # chain-end node; interior nodes are resurrected with the
-                # pruned pointer list so sibling branches can still extract
-                # their sequences (SharedVersionedBufferStoreImpl.java:187-198).
+                # Prune the traversed pointer and write the node back (with
+                # the decremented refcount) -- even if it was just deleted
+                # above. Deletion only sticks for the chain-end node;
+                # interior nodes are resurrected with the pruned pointer list
+                # so sibling branches can still extract their sequences
+                # (SharedVersionedBufferStoreImpl.java:187-198).
+                node.refs = refs_left
                 if pointer in node.predecessors:
                     node.predecessors.remove(pointer)
                 self._store[key] = node
@@ -196,3 +253,77 @@ class ReadOnlySharedVersionBuffer(Generic[K, V]):
 
     def get(self, matched: Matched, version: DeweyVersion) -> Sequence[K, V]:
         return self._buffer.get(matched, version)
+
+
+class LineageNode(Generic[K, V]):
+    """One appended event in a run's exact lineage chain."""
+
+    __slots__ = ("stage_name", "event", "parent")
+
+    def __init__(self, stage_name: str, event: Event[K, V], parent: Optional[int]) -> None:
+        self.stage_name = stage_name
+        self.event = event
+        self.parent = parent
+
+
+class LineageBuffer(Generic[K, V]):
+    """Exact-lineage partial-match store: the host mirror of the device pool.
+
+    Redesign of the reference's shared versioned buffer
+    (SharedVersionedBufferStoreImpl.java:45-212). The reference merges all
+    runs' partial matches into nodes keyed by (stage, event) and routes
+    extraction by Dewey-version compatibility -- which is ambiguous whenever
+    two pointers carry versions compatible with the same request (reachable:
+    two runs can legitimately hold equal version digits after independent
+    addRun() bumps), silently splicing one run's prefix onto another's
+    match. Here every put appends a fresh node holding an exact parent
+    index, each run tracks its chain head (ComputationStage.last_node), and
+    extraction is a plain parent walk -- unambiguous by construction, the
+    same scheme as the device engine's node pool (ops/engine.py: node_pred
+    per slot, lane `node` index). Branch clones share prefixes by pointing
+    at the same parent; there are no refcounts -- reclamation is mark-sweep
+    from the live runs' chain heads (`gc`), the host analog of the device's
+    batch-boundary compaction (ops/runtime.py:_compact).
+
+    Shared-prefix storage, one-node-per-(stage,event)-per-lineage: the
+    reference's space saving across SIMULTANEOUS runs of one branch family
+    is kept (branches share parents); only its cross-run node merging --
+    the source of the routing ambiguity -- is dropped.
+    """
+
+    def __init__(self) -> None:
+        self._nodes: Dict[int, LineageNode[K, V]] = {}
+        self._next_id = 0
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def append(self, stage: Stage, event: Event[K, V], parent: Optional[int]) -> int:
+        """Store one consumed event; returns the new chain head id."""
+        if parent is not None and parent not in self._nodes:
+            raise ValueError(f"Cannot find predecessor node {parent}")
+        node_id = self._next_id
+        self._next_id += 1
+        self._nodes[node_id] = LineageNode(stage.name, event, parent)
+        return node_id
+
+    def sequence(self, head: Optional[int]) -> Sequence[K, V]:
+        """Materialize the chain ending at `head` (newest -> oldest walk)."""
+        builder: SequenceBuilder[K, V] = SequenceBuilder()
+        node_id = head
+        while node_id is not None:
+            node = self._nodes[node_id]
+            builder.add(node.stage_name, node.event)
+            node_id = node.parent
+        return builder.build(reversed_=True)
+
+    def gc(self, live_heads: "List[Optional[int]]") -> None:
+        """Mark-sweep: keep only chains reachable from live runs' heads."""
+        marked: set = set()
+        for head in live_heads:
+            node_id = head
+            while node_id is not None and node_id not in marked:
+                marked.add(node_id)
+                node_id = self._nodes[node_id].parent
+        if len(marked) != len(self._nodes):
+            self._nodes = {i: n for i, n in self._nodes.items() if i in marked}

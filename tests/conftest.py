@@ -1,13 +1,29 @@
 """Test harness config: force JAX onto a virtual 8-device CPU mesh.
 
-Device-path tests exercise multi-chip sharding on virtual CPU devices; the
-real-TPU benchmark path is driven by bench.py instead.
+Device-path tests exercise the engine and multi-chip sharding on virtual
+CPU devices so they are deterministic and independent of the TPU tunnel's
+health; the real-TPU benchmark path is driven by bench.py instead (no
+conftest there, so it keeps the ambient axon/TPU platform).
+
+Setting JAX_PLATFORMS=cpu alone is not enough: the axon PJRT plugin is
+registered by sitecustomize at interpreter start and `jax.backends()`
+initializes every registered plugin, hanging all tests whenever the TPU
+tunnel is down. Dropping the factory before the first backend init keeps
+the test process purely local.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+# The axon register hook sets jax_platforms=axon via jax.config at
+# interpreter start, so the env var alone no longer wins.
+jax.config.update("jax_platforms", "cpu")
+_xb._backend_factories.pop("axon", None)
