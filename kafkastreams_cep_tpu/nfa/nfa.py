@@ -38,7 +38,7 @@ from ..core.event import Event
 from ..core.sequence import Sequence
 from ..pattern.stages import Edge, EdgeOperation, Stage, Stages
 from ..state.aggregates import AggregatesStore, States
-from ..state.buffer import LineageBuffer
+from ..state.buffer import Matched, ReadOnlySharedVersionBuffer, SharedVersionedBuffer
 from .context import FoldEnv, MatcherContext
 
 K = TypeVar("K")

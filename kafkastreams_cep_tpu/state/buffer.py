@@ -255,75 +255,3 @@ class ReadOnlySharedVersionBuffer(Generic[K, V]):
         return self._buffer.get(matched, version)
 
 
-class LineageNode(Generic[K, V]):
-    """One appended event in a run's exact lineage chain."""
-
-    __slots__ = ("stage_name", "event", "parent")
-
-    def __init__(self, stage_name: str, event: Event[K, V], parent: Optional[int]) -> None:
-        self.stage_name = stage_name
-        self.event = event
-        self.parent = parent
-
-
-class LineageBuffer(Generic[K, V]):
-    """Exact-lineage partial-match store: the host mirror of the device pool.
-
-    Redesign of the reference's shared versioned buffer
-    (SharedVersionedBufferStoreImpl.java:45-212). The reference merges all
-    runs' partial matches into nodes keyed by (stage, event) and routes
-    extraction by Dewey-version compatibility -- which is ambiguous whenever
-    two pointers carry versions compatible with the same request (reachable:
-    two runs can legitimately hold equal version digits after independent
-    addRun() bumps), silently splicing one run's prefix onto another's
-    match. Here every put appends a fresh node holding an exact parent
-    index, each run tracks its chain head (ComputationStage.last_node), and
-    extraction is a plain parent walk -- unambiguous by construction, the
-    same scheme as the device engine's node pool (ops/engine.py: node_pred
-    per slot, lane `node` index). Branch clones share prefixes by pointing
-    at the same parent; there are no refcounts -- reclamation is mark-sweep
-    from the live runs' chain heads (`gc`), the host analog of the device's
-    batch-boundary compaction (ops/runtime.py:_compact).
-
-    Shared-prefix storage, one-node-per-(stage,event)-per-lineage: the
-    reference's space saving across SIMULTANEOUS runs of one branch family
-    is kept (branches share parents); only its cross-run node merging --
-    the source of the routing ambiguity -- is dropped.
-    """
-
-    def __init__(self) -> None:
-        self._nodes: Dict[int, LineageNode[K, V]] = {}
-        self._next_id = 0
-
-    def __len__(self) -> int:
-        return len(self._nodes)
-
-    def append(self, stage: Stage, event: Event[K, V], parent: Optional[int]) -> int:
-        """Store one consumed event; returns the new chain head id."""
-        if parent is not None and parent not in self._nodes:
-            raise ValueError(f"Cannot find predecessor node {parent}")
-        node_id = self._next_id
-        self._next_id += 1
-        self._nodes[node_id] = LineageNode(stage.name, event, parent)
-        return node_id
-
-    def sequence(self, head: Optional[int]) -> Sequence[K, V]:
-        """Materialize the chain ending at `head` (newest -> oldest walk)."""
-        builder: SequenceBuilder[K, V] = SequenceBuilder()
-        node_id = head
-        while node_id is not None:
-            node = self._nodes[node_id]
-            builder.add(node.stage_name, node.event)
-            node_id = node.parent
-        return builder.build(reversed_=True)
-
-    def gc(self, live_heads: "List[Optional[int]]") -> None:
-        """Mark-sweep: keep only chains reachable from live runs' heads."""
-        marked: set = set()
-        for head in live_heads:
-            node_id = head
-            while node_id is not None and node_id not in marked:
-                marked.add(node_id)
-                node_id = self._nodes[node_id].parent
-        if len(marked) != len(self._nodes):
-            self._nodes = {i: n for i, n in self._nodes.items() if i in marked}
