@@ -20,7 +20,7 @@ from .pattern.pattern import Pattern, Selected, Strategy
 from .pattern.stages import EdgeOperation, Stage, Stages, StateType
 from .nfa.nfa import NFA, ComputationStage, initial_computation_stage
 from .state.aggregates import AggregatesStore, States, UnknownAggregateException
-from .state.buffer import Matched, SharedVersionedBuffer
+from .state.buffer import SharedVersionedBuffer
 from .state.nfa_store import NFAStates, NFAStore
 from .streams.builder import ComplexStreamsBuilder
 from .streams.processor import CEPProcessor
@@ -57,7 +57,6 @@ __all__ = [
     "AggregatesStore",
     "States",
     "UnknownAggregateException",
-    "Matched",
     "SharedVersionedBuffer",
     "NFAStates",
     "NFAStore",

@@ -16,7 +16,7 @@ from ..core.sequence import Sequence
 from ..pattern.expressions import Env
 from ..pattern.stages import Stage
 from ..state.aggregates import States
-from ..state.buffer import Matched, ReadOnlySharedVersionBuffer
+from ..state.buffer import ReadOnlySharedVersionBuffer
 
 
 class MatcherContext:
@@ -28,7 +28,7 @@ class MatcherContext:
         "previous_event",
         "current_event",
         "states",
-        "previous_key",
+        "previous_node",
     )
 
     def __init__(
@@ -40,7 +40,7 @@ class MatcherContext:
         previous_event: Optional[Event],
         current_event: Event,
         states: States,
-        previous_key: Optional[Matched] = None,
+        previous_node: Optional[int] = None,
     ) -> None:
         self.buffer = buffer
         self.version = version
@@ -49,23 +49,19 @@ class MatcherContext:
         self.previous_event = previous_event
         self.current_event = current_event
         self.states = states
-        self.previous_key = previous_key
+        self.previous_node = previous_node
 
     def partial_sequence(self) -> Sequence:
         """Materialize the partial match for sequence predicates.
 
         Mirrors SequenceMatcher's default accept (SequenceMatcher.java:22-26):
-        reads the buffer from the run's last stored node along the current
-        version (by recorded key -- see ComputationStage.last_key -- with the
-        reference's (previousStage, previousEvent) reconstruction as
-        fallback).
+        walks the run's lineage chain from its last stored node
+        (ComputationStage.last_node); an exact parent walk, no version
+        routing (see state/buffer.py).
         """
-        key = self.previous_key
-        if key is None:
-            if self.previous_stage is None or self.previous_event is None:
-                return Sequence([])
-            key = Matched.from_parts(self.previous_stage, self.previous_event)
-        return self.buffer.get(key, self.version)
+        if self.previous_node is None:
+            return Sequence([])
+        return self.buffer.get(self.previous_node)
 
     def env(self) -> "HostEventEnv":
         return HostEventEnv(self.current_event, self.states)

@@ -9,8 +9,8 @@ the edge operations:
 
   * PROCEED/SKIP_PROCEED: epsilon descent, extending the Dewey version with a
     new stage digit when genuinely crossing to the next stage;
-  * TAKE: consume on a self loop, re-adding the run, buffer put with a
-    branch-aware version (NFA.java:238-255);
+  * TAKE: consume on a self loop, re-adding the run, buffer put chained to
+    the run's lineage (NFA.java:238-255);
   * BEGIN: consume and forward via a synthesized epsilon state
     (NFA.java:256-271);
   * IGNORE: re-add the run unchanged (NFA.java:272-285);
@@ -18,10 +18,22 @@ the edge operations:
 branches a run when one event matches >=2 edge combinations
 (PROCEED+TAKE / IGNORE+TAKE / IGNORE+BEGIN / IGNORE+PROCEED,
 NFA.java:392-397) -- cloning the run with a bumped Dewey number (addRun(2)
-from a begin state), duplicating fold registers and incrementing buffer
-refcounts -- and always re-adds the begin state so new matches can start
+from a begin state), duplicating fold registers and sharing the lineage
+prefix -- and always re-adds the begin state so new matches can start
 (NFA.java:323-338). Matches are extracted from the shared buffer when a run
 forwards to the final state.
+
+Partial matches live in the exact-lineage shared buffer (state/buffer.py):
+each run tracks the node id of its last consumed event (`last_node`, the
+host analog of the device engine's per-lane node index) and extraction is an
+unambiguous parent walk. The reference instead routes a merged
+(stage, event)-keyed store by Dewey-version compatibility
+(SharedVersionedBufferStoreImpl.java:176-201), which splices runs' prefixes
+whenever independent addRun() bumps produce colliding version tags -- a
+reference bug this redesign does not reproduce (see state/buffer.py).
+Dewey versions are still maintained run-for-run (they are part of the
+observable run-queue shape and drive branch numbering) -- they just no
+longer route storage.
 
 The TPU engine (ops/engine.py) implements the same transition relation as a
 vmapped kernel over fixed-capacity run lanes with the epsilon descent
@@ -30,15 +42,15 @@ contract.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field, replace
-from typing import Any, Generic, List, Optional, Set, TypeVar
+from dataclasses import dataclass, replace
+from typing import Generic, List, Optional, Set, TypeVar
 
 from ..core.dewey import DeweyVersion
 from ..core.event import Event
 from ..core.sequence import Sequence
 from ..pattern.stages import Edge, EdgeOperation, Stage, Stages
 from ..state.aggregates import AggregatesStore, States
-from ..state.buffer import Matched, ReadOnlySharedVersionBuffer, SharedVersionedBuffer
+from ..state.buffer import ReadOnlySharedVersionBuffer, SharedVersionedBuffer
 from .context import FoldEnv, MatcherContext
 
 K = TypeVar("K")
@@ -56,23 +68,20 @@ class ComputationStage(Generic[K, V]):
     timestamp: int = -1
     is_branching: bool = False
     is_ignored: bool = False
-    #: exact buffer key the run's last consumed event was stored under.
-    #: Deliberate divergence: the reference reconstructs this key from
-    #: (previousStage, previousEvent) at put time (NFA.java:351-360), which
-    #: breaks when the storing stage and the descent's previous stage carry
-    #: different StateTypes -- e.g. one_or_more on the first pattern stores
-    #: under (name, BEGIN) via the internal begin stage but looks up
-    #: (name, NORMAL) via the TAKE stage when the successor matches with zero
-    #: takes, so the reference throws IllegalStateException
-    #: ("Cannot find predecessor event"). Tracking the key explicitly is the
-    #: host analog of the device engine's per-lane last-node *index*.
-    last_key: Optional[Matched] = None
+    #: buffer node id of the run's last consumed event (chain head). The
+    #: reference reconstructs a store key from (previousStage, previousEvent)
+    #: at put time (NFA.java:351-360), which breaks when the storing stage
+    #: and the descent's previous stage carry different StateTypes; tracking
+    #: the chain head explicitly is the host analog of the device engine's
+    #: per-lane last-node *index* and sidesteps both that bug and the
+    #: version-routing ambiguity (see state/buffer.py).
+    last_node: Optional[int] = None
 
     def with_version(self, version: DeweyVersion) -> "ComputationStage[K, V]":
         # Mirrors ComputationStage.setVersion: branching/ignored flags reset.
         return ComputationStage(
             self.stage, version, self.sequence, self.last_event, self.timestamp,
-            last_key=self.last_key,
+            last_node=self.last_node,
         )
 
     @property
@@ -97,7 +106,7 @@ def initial_computation_stage(stages: Stages) -> ComputationStage:
 
 
 class NFA(Generic[K, V]):
-    """Non-deterministic finite automaton over a shared versioned buffer."""
+    """Non-deterministic finite automaton over the exact-lineage shared buffer."""
 
     def __init__(
         self,
@@ -131,29 +140,33 @@ class NFA(Generic[K, V]):
         """Process one event; returns completed matches in emission order."""
         to_process = len(self.computation_stages)
         final_states: List[ComputationStage[K, V]] = []
+        any_died = False
 
         while to_process > 0:
             to_process -= 1
             computation = self.computation_stages.pop(0)
             states = self._match_computation(computation, event)
             if not states:
-                self._remove_pattern(computation)
-            else:
-                final_states.extend(s for s in states if s.is_forwarding_to_final)
+                any_died = True
+            final_states.extend(s for s in states if s.is_forwarding_to_final)
             self.computation_stages.extend(s for s in states if not s.is_forwarding_to_final)
 
-        return self._match_construction(final_states)
+        matches = self._match_construction(final_states)
+        # Reclaim chains no longer reachable from any live run: the mark-sweep
+        # that replaces the reference's per-extraction refcount GC
+        # (SharedVersionedBufferStoreImpl.java:176-201). Nodes can only become
+        # unreachable when a run dies or leaves the queue through the final
+        # state (every other transition retains its chain prefix), so the
+        # sweep is skipped otherwise.
+        if final_states or any_died:
+            self.buffer.gc(c.last_node for c in self.computation_stages)
+        return matches
 
     # ------------------------------------------------------------ internals
     def _match_construction(
         self, states: List[ComputationStage[K, V]]
     ) -> List[Sequence[K, V]]:
-        return [self.buffer.remove(c.last_key, c.version) for c in states]
-
-    def _remove_pattern(self, computation: ComputationStage[K, V]) -> None:
-        if computation.last_key is None:
-            return
-        self.buffer.remove(computation.last_key, computation.version)
+        return [self.buffer.get(c.last_node) for c in states]
 
     def _match_computation(
         self, computation: ComputationStage[K, V], event: Event[K, V]
@@ -170,7 +183,7 @@ class NFA(Generic[K, V]):
         sequence: int,
         previous_stage: Optional[Stage],
         current_stage: Stage,
-        previous_key: Optional[Matched] = None,
+        previous_node: Optional[int] = None,
     ) -> List[Edge]:
         states = States(self.aggregates_store, current_event.key, sequence)
         read_only = ReadOnlySharedVersionBuffer(self.buffer)
@@ -182,7 +195,7 @@ class NFA(Generic[K, V]):
             previous_event=previous_event,
             current_event=current_event,
             states=states,
-            previous_key=previous_key,
+            previous_node=previous_node,
         )
         return [e for e in current_stage.edges if e.predicate.accept(MatcherContext(**ctx_args))]
 
@@ -215,12 +228,12 @@ class NFA(Generic[K, V]):
 
         sequence_id = computation.sequence
         previous_event = computation.last_event
-        previous_key = computation.last_key
+        previous_node = computation.last_node
         version = computation.version
 
         matched_edges = self._matched_edges(
             previous_event, event, version, sequence_id, previous_stage, current_stage,
-            previous_key,
+            previous_node,
         )
         operations = [e.operation for e in matched_edges]
         is_branching = self._is_branching(operations)
@@ -231,6 +244,7 @@ class NFA(Generic[K, V]):
         next_stages: List[ComputationStage[K, V]] = []
         consumed = False
         proceed = False
+        consumed_node: Optional[int] = None
 
         for edge in matched_edges:
             op = edge.operation
@@ -250,8 +264,10 @@ class NFA(Generic[K, V]):
                     proceed = True
 
             elif op == EdgeOperation.TAKE:
-                # Consume on the self loop: the run stays at this stage.
-                consumed_key = Matched.from_parts(current_stage, event)
+                # Consume on the self loop: the run stays at this stage
+                # (NFA.java:238-255; the reference's branch-aware put version
+                # only routed the merged store -- lineage needs no tag).
+                consumed_node = self.buffer.put(current_stage.name, event, previous_node)
                 next_stages.append(
                     ComputationStage(
                         stage=Stage.new_epsilon(current_stage, current_stage),
@@ -259,20 +275,13 @@ class NFA(Generic[K, V]):
                         sequence=sequence_id,
                         last_event=event,
                         timestamp=start_time,
-                        last_key=consumed_key,
+                        last_node=consumed_node,
                     )
                 )
-                if not is_branching or ignored:
-                    self._put_to_buffer(current_stage, previous_key, event, version)
-                else:
-                    self._put_to_buffer(
-                        current_stage, previous_key, event, version.add_run()
-                    )
                 consumed = True
 
             elif op == EdgeOperation.BEGIN:
-                consumed_key = Matched.from_parts(current_stage, event)
-                self._put_to_buffer(current_stage, previous_key, event, version)
+                consumed_node = self.buffer.put(current_stage.name, event, previous_node)
                 next_stages.append(
                     ComputationStage(
                         stage=Stage.new_epsilon(current_stage, edge.target),
@@ -280,7 +289,7 @@ class NFA(Generic[K, V]):
                         sequence=sequence_id,
                         last_event=event,
                         timestamp=start_time,
-                        last_key=consumed_key,
+                        last_node=consumed_node,
                     )
                 )
                 consumed = True
@@ -305,9 +314,10 @@ class NFA(Generic[K, V]):
                     prev_is_begin = True
                 run_offset = 2 if (prev_is_begin and len(version.digits) >= 2) else 1
                 next_version = version.add_run(run_offset)
-                clone_key = (
-                    previous_key if ignored else Matched.from_parts(current_stage, event)
-                )
+                # The clone shares the lineage prefix by pointing at the same
+                # node: the reference's branch() refcount walk
+                # (NFA.java:289-317) is structural sharing here.
+                clone_node = previous_node if ignored else consumed_node
                 next_stages.append(
                     ComputationStage(
                         stage=branch_stage,
@@ -316,23 +326,11 @@ class NFA(Generic[K, V]):
                         last_event=last_event,
                         timestamp=start_time,
                         is_branching=True,
-                        last_key=clone_key,
+                        last_node=clone_node,
                     )
                 )
                 for agg_name in self.aggregates_names:
                     self.aggregates_store.branch(event.key, agg_name, sequence_id, new_sequence)
-                # Pin the clone's shared chain. Deliberate divergence: the
-                # reference skips branch() off a begin previous stage
-                # (NFA.java:311-313), leaving the shared begin-rooted node
-                # unpinned -- if the sibling run dies first, its removal
-                # deletes the shared node and the reference then throws
-                # IllegalStateException("Cannot find predecessor event",
-                # SharedVersionedBufferStoreImpl.java:113-115) or silently
-                # truncates matches. Pinning every shared chain keeps the
-                # buffer sound; the device engine is immune by construction
-                # (index-linked chains + mark-sweep GC, no refcounts).
-                if previous_key is not None:
-                    self.buffer.branch_from(previous_key, version)
             elif not proceed:
                 next_stages.append(root)
 
@@ -365,23 +363,6 @@ class NFA(Generic[K, V]):
             and not computation.is_branching
             and not computation.is_ignored
         )
-
-    def _put_to_buffer(
-        self,
-        current_stage: Stage,
-        previous_key: Optional[Matched],
-        event: Event[K, V],
-        version: DeweyVersion,
-    ) -> None:
-        """Append the consumed event, chained to the run's last stored node.
-
-        Root put when the run has no predecessor node (fresh runs and clones
-        parked by begin-state branching). Linking by the run's recorded
-        last_key -- not by reconstructing a key from (previousStage,
-        previousEvent) as the reference does (NFA.java:351-360) -- is what
-        keeps the chain sound; see ComputationStage.last_key.
-        """
-        self.buffer.put_keyed(current_stage, event, previous_key, version)
 
     def _evaluate_aggregates(self, stage: Stage, sequence: int, event: Event[K, V]) -> None:
         for aggregator in stage.aggregates:

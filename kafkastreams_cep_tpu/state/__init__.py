@@ -1,4 +1,4 @@
 from .aggregates import AggregatesStore, States, UnknownAggregateException
-from .buffer import BufferNode, Matched, Pointer, ReadOnlySharedVersionBuffer, SharedVersionedBuffer
+from .buffer import BufferNode, BufferStore, ReadOnlySharedVersionBuffer, SharedVersionedBuffer
 from .naming import aggregates_store, event_buffer_store, nfa_states_store, normalize_query_name
 from .nfa_store import NFAStates, NFAStore

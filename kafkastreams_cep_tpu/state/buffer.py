@@ -1,248 +1,128 @@
-"""Shared versioned buffer: the SASE partial-match pointer graph.
+"""Shared versioned buffer: the SASE partial-match store, exact-lineage form.
 
 Re-design of the reference buffer
 (reference: core/.../cep/state/SharedVersionedBufferStore.java:32-77,
 state/internal/SharedVersionedBufferStoreImpl.java:45-212,
-state/internal/MatchedEvent.java, state/internal/Matched.java). Partial
-matches of all simultaneous runs are stored once in a compact pointer graph:
-nodes are keyed by (stage name, stage type, event id); each node holds a
-refcount and a list of version-tagged predecessor pointers. Sequence
-extraction walks pointers backwards choosing the predecessor whose version
-is Dewey-compatible with the requested one.
+state/internal/MatchedEvent.java, state/internal/Matched.java). The
+reference stores partial matches of all simultaneous runs in one pointer
+graph whose nodes are keyed by (stage, event) and whose predecessor pointers
+are tagged with Dewey versions; extraction walks backwards choosing the
+pointer whose version is Dewey-compatible with the requested one
+(SharedVersionedBufferStoreImpl.java:176-201, MatchedEvent.java:90-98).
 
-The host store is a plain dict (the oracle). The device equivalent is an
-HBM-resident node pool with the same (stage, event) keying and refcount
-discipline (ops/engine.py).
+That routing is ambiguous: two runs can legitimately carry EQUAL version
+digits after independent addRun() bumps (e.g. a branch clone parked on an
+epsilon stage and an ordinary run, both at version "2.0"), and when both
+consume the same event at the same stage the shared node holds two pointers
+tagged "2.0" -- extraction then splices one run's prefix onto the other
+run's match and silently drops events the run actually consumed. This is
+observable in the reference itself; it is a correctness bug, not a
+behavior to reproduce.
+
+This store therefore keeps the reference's *sharing* (branch clones share
+their prefix chain -- the SASE space optimization) but drops the ambiguous
+cross-run node merging: every put appends a fresh node holding an exact
+parent index, each run tracks its chain head by node id
+(ComputationStage.last_node), and extraction is a plain parent walk --
+unambiguous by construction. This is the same scheme as the device engine's
+HBM node pool (ops/engine.py: node_pred per slot, per-lane `node` index),
+which makes host and device agree on match lineage by design. Refcounts are
+replaced by mark-sweep reclamation from the live runs' chain heads (`gc`),
+the host analog of the device's batch-boundary compaction
+(ops/runtime.py:_compact).
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Dict, Generic, List, Optional, Tuple, TypeVar
+from typing import Any, Dict, Generic, Iterable, Optional, TypeVar
 
-from ..core.dewey import DeweyVersion
 from ..core.event import Event
 from ..core.sequence import Sequence, SequenceBuilder
-from ..pattern.stages import Stage, StateType
 
 K = TypeVar("K")
 V = TypeVar("V")
 
 
-@dataclass(frozen=True)
-class Matched:
-    """Node key: stage identity + event identity (Matched.java:21-70)."""
-
-    stage_name: str
-    stage_type: StateType
-    topic: str
-    partition: int
-    offset: int
-
-    @staticmethod
-    def from_parts(stage: Stage, event: Event) -> "Matched":
-        return Matched(stage.name, stage.type, event.topic, event.partition, event.offset)
-
-
-class Pointer:
-    """A version-tagged predecessor pointer (MatchedEvent.Pointer)."""
-
-    __slots__ = ("version", "key")
-
-    def __init__(self, version: DeweyVersion, key: Optional[Matched]) -> None:
-        self.version = version
-        self.key = key
-
-    def __eq__(self, other: object) -> bool:
-        if not isinstance(other, Pointer):
-            return NotImplemented
-        return self.version == other.version and self.key == other.key
-
-    def __hash__(self) -> int:
-        return hash((self.version, self.key))
-
-    def __repr__(self) -> str:
-        return f"Pointer(version={self.version}, key={self.key})"
-
-
 class BufferNode(Generic[K, V]):
-    """A stored event + refcount + predecessor pointers (MatchedEvent.java)."""
+    """One appended event in a run's lineage chain (MatchedEvent analog)."""
 
-    __slots__ = ("key", "value", "timestamp", "refs", "predecessors")
+    __slots__ = ("stage_name", "event", "parent")
 
-    def __init__(self, key: K, value: V, timestamp: int) -> None:
-        self.key = key
-        self.value = value
-        self.timestamp = timestamp
-        self.refs = 1
-        self.predecessors: List[Pointer] = []
-
-    def add_predecessor(self, version: DeweyVersion, key: Optional[Matched]) -> None:
-        self.predecessors.append(Pointer(version, key))
-
-    def pointer_by_version(self, version: DeweyVersion) -> Optional[Pointer]:
-        for pointer in self.predecessors:
-            if version.is_compatible(pointer.version):
-                return pointer
-        return None
-
-    def decrement_ref(self) -> int:
-        if self.refs > 0:
-            self.refs -= 1
-        return self.refs
+    def __init__(self, stage_name: str, event: Event[K, V], parent: Optional[int]) -> None:
+        self.stage_name = stage_name
+        self.event = event
+        self.parent = parent
 
     def __repr__(self) -> str:
-        return (
-            f"BufferNode(value={self.value!r}, ts={self.timestamp}, refs={self.refs}, "
-            f"preds={self.predecessors!r})"
-        )
+        return f"BufferNode(stage={self.stage_name!r}, event={self.event!r}, parent={self.parent})"
 
 
 class SharedVersionedBuffer(Generic[K, V]):
-    """Dict-backed shared versioned buffer (the host oracle store)."""
+    """Append-only lineage store with shared prefixes (the host oracle store).
+
+    API shape follows the reference contract
+    (SharedVersionedBufferStore.java:32-77) translated to index-linked
+    chains: `put` appends and returns the new chain head, `get` materializes
+    a chain into a `Sequence`, and reclamation is `gc` over live heads
+    instead of per-extraction refcount decrements.
+    """
 
     def __init__(self) -> None:
-        self._store: Dict[Matched, BufferNode[K, V]] = {}
+        self._nodes: Dict[int, BufferNode[K, V]] = {}
+        self._next_id = 0
 
     def __len__(self) -> int:
-        return len(self._store)
+        return len(self._nodes)
 
     # -- writes --------------------------------------------------------------
-    def put(
-        self,
-        curr_stage: Stage,
-        curr_event: Event[K, V],
-        prev_stage: Optional[Stage] = None,
-        prev_event: Optional[Event[K, V]] = None,
-        version: Optional[DeweyVersion] = None,
-    ) -> None:
-        """Append an event; with a predecessor, link a version-tagged pointer."""
-        assert version is not None
-        if prev_stage is None:
-            # Root put: a null-predecessor pointer records the version (run)
-            # it belongs to. Deliberate divergence: the reference always
-            # creates a fresh node here ("can only be added once",
-            # SharedVersionedBufferStoreImpl.java:149-157), which CLOBBERS the
-            # pointer list when another run already shares the same
-            # (stage, event) node -- reachable via an optional stage's
-            # SKIP_PROCEED when the successor event also completes non-skipped
-            # runs, truncating their extracted matches. Load-or-create keeps
-            # the buffer sound; the device engine is immune (per-run chain
-            # indices, no keyed store).
-            curr_key = Matched.from_parts(curr_stage, curr_event)
-            node = self._store.get(curr_key)
-            if node is None:
-                node = BufferNode(curr_event.key, curr_event.value, curr_event.timestamp)
-            node.add_predecessor(version, None)
-            self._store[curr_key] = node
-            return
+    def put(self, stage_name: str, event: Event[K, V], parent: Optional[int] = None) -> int:
+        """Append one consumed event chained to `parent`; returns its node id.
 
-        prev_key = Matched.from_parts(prev_stage, prev_event)
-        curr_key = Matched.from_parts(curr_stage, curr_event)
-
-        if prev_key not in self._store:
-            raise ValueError(f"Cannot find predecessor event for {prev_key}")
-
-        node = self._store.get(curr_key)
-        if node is None:
-            node = BufferNode(curr_event.key, curr_event.value, curr_event.timestamp)
-        node.add_predecessor(version, prev_key)
-        self._store[curr_key] = node
-
-    def put_keyed(
-        self,
-        curr_stage: Stage,
-        curr_event: Event[K, V],
-        prev_key: Optional[Matched],
-        version: DeweyVersion,
-    ) -> None:
-        """Append an event chained to an exact predecessor node key.
-
-        The NFA runtime records each run's last stored node key
-        (ComputationStage.last_key) and links through it, avoiding the
-        reference's key reconstruction from (previousStage, previousEvent)
-        (NFA.java:351-360) whose StateType can disagree with the storing
-        stage's.
+        The root put (parent None) starts a new lineage
+        (SharedVersionedBufferStoreImpl.java:149-157); a chained put is the
+        reference's predecessor-linked put (:101-126) without the version
+        tag -- the parent index IS the (unambiguous) pointer.
         """
-        if prev_key is None:
-            self.put(curr_stage, curr_event, version=version)
-            return
-        if prev_key not in self._store:
-            raise ValueError(f"Cannot find predecessor event for {prev_key}")
-        curr_key = Matched.from_parts(curr_stage, curr_event)
-        node = self._store.get(curr_key)
-        if node is None:
-            node = BufferNode(curr_event.key, curr_event.value, curr_event.timestamp)
-        node.add_predecessor(version, prev_key)
-        self._store[curr_key] = node
-
-    def branch(self, stage: Stage, event: Event[K, V], version: DeweyVersion) -> None:
-        """Increment refcounts along the predecessor chain of a new branch."""
-        self.branch_from(Matched.from_parts(stage, event), version)
-
-    def branch_from(self, key: Matched, version: DeweyVersion) -> None:
-        """branch() by exact node key (see put_keyed)."""
-        pointer: Optional[Pointer] = Pointer(version, key)
-        while pointer is not None and pointer.key is not None:
-            node = self._store[pointer.key]
-            node.refs += 1
-            pointer = node.pointer_by_version(pointer.version)
+        if parent is not None and parent not in self._nodes:
+            raise ValueError(f"Cannot find predecessor node {parent}")
+        node_id = self._next_id
+        self._next_id += 1
+        self._nodes[node_id] = BufferNode(stage_name, event, parent)
+        return node_id
 
     # -- reads ---------------------------------------------------------------
-    def get(self, matched: Matched, version: DeweyVersion) -> Sequence[K, V]:
-        # Side-effect-free read: the reference's peek(remove=false) decrements
-        # refcounts only on a throwaway deserialized copy, which is
-        # equivalent to not decrementing at all.
-        return self._peek(matched, version, remove=False, decrement=False)
+    def get(self, head: Optional[int]) -> Sequence[K, V]:
+        """Materialize the chain ending at `head`, oldest stage first.
 
-    def remove(self, matched: Matched, version: DeweyVersion) -> Sequence[K, V]:
-        return self._peek(matched, version, remove=True)
-
-    def _peek(
-        self, matched: Matched, version: DeweyVersion, remove: bool, decrement: bool = True
-    ) -> Sequence[K, V]:
-        """Walk the version-routed chain; with remove=True, GC unshared nodes.
-
-        Refcount discipline is reference-exact
-        (SharedVersionedBufferStoreImpl.java:176-201): the decrement happens
-        on a throwaway copy and is PERSISTED only on the refs_left==0
-        write-back path, so a node whose stored refcount is >=2 (pinned by
-        branch()) is never deleted -- shared chains are immortal. This leak
-        is deliberate: persisting every decrement instead (as an earlier
-        revision did) deletes nodes still referenced by live runs whenever
-        two matches extract through a shared prefix while an ignore-re-added
-        run retains it, and later puts then fail. The device engine has
-        neither problem (mark-sweep GC over per-lane chain indices).
+        The analog of peek(remove=false): sequence assembly in reverse while
+        walking predecessors (SharedVersionedBufferStoreImpl.java:176-201,
+        Sequence.java:211-222).
         """
-        pointer: Optional[Pointer] = Pointer(version, matched)
         builder: SequenceBuilder[K, V] = SequenceBuilder()
-
-        while pointer is not None and pointer.key is not None:
-            key = pointer.key
-            node = self._store.get(key)
-            if node is None:
-                break
-            refs_left = max(0, node.refs - 1) if decrement else node.refs
-            if remove and refs_left == 0 and len(node.predecessors) <= 1:
-                del self._store[key]
-
-            builder.add(
-                key.stage_name,
-                Event(node.key, node.value, node.timestamp, key.topic, key.partition, key.offset),
-            )
-            pointer = node.pointer_by_version(pointer.version)
-            if remove and pointer is not None and refs_left == 0:
-                # Prune the traversed pointer and write the node back (with
-                # the decremented refcount) -- even if it was just deleted
-                # above. Deletion only sticks for the chain-end node;
-                # interior nodes are resurrected with the pruned pointer list
-                # so sibling branches can still extract their sequences
-                # (SharedVersionedBufferStoreImpl.java:187-198).
-                node.refs = refs_left
-                if pointer in node.predecessors:
-                    node.predecessors.remove(pointer)
-                self._store[key] = node
-
+        node_id = head
+        while node_id is not None:
+            node = self._nodes[node_id]
+            builder.add(node.stage_name, node.event)
+            node_id = node.parent
         return builder.build(reversed_=True)
+
+    # -- reclamation ---------------------------------------------------------
+    def gc(self, live_heads: Iterable[Optional[int]]) -> int:
+        """Mark-sweep: keep only chains reachable from live runs' heads.
+
+        Replaces the reference's refcount decrements during extraction
+        (which, combined with branch() pinning, leak shared chains -- see
+        round-2 analysis). Returns the number of reclaimed nodes.
+        """
+        marked: set = set()
+        for head in live_heads:
+            node_id = head
+            while node_id is not None and node_id not in marked:
+                marked.add(node_id)
+                node_id = self._nodes[node_id].parent
+        dead_ids = [i for i in self._nodes if i not in marked]
+        for i in dead_ids:
+            del self._nodes[i]
+        return len(dead_ids)
 
 
 class ReadOnlySharedVersionBuffer(Generic[K, V]):
@@ -251,7 +131,32 @@ class ReadOnlySharedVersionBuffer(Generic[K, V]):
     def __init__(self, buffer: SharedVersionedBuffer[K, V]) -> None:
         self._buffer = buffer
 
-    def get(self, matched: Matched, version: DeweyVersion) -> Sequence[K, V]:
-        return self._buffer.get(matched, version)
+    def get(self, head: Optional[int]) -> Sequence[K, V]:
+        return self._buffer.get(head)
 
 
+class BufferStore(Generic[K, V]):
+    """The query-level buffer state store: one lineage buffer per record key.
+
+    The reference keeps all keys' partial matches in a single KV store
+    (SharedVersionedBufferStoreImpl.java:49) -- safe there because node keys
+    embed event identity and reclamation is per-chain refcounts. With
+    mark-sweep reclamation, sharing one arena across keys would let one
+    key's GC see only its own live heads, so the store is partitioned per
+    record key (chains never cross keys: each key owns its NFA,
+    CEPProcessor.java:111-124). The device engine partitions identically
+    (one node pool per key lane, parallel/key_shard.py).
+    """
+
+    def __init__(self) -> None:
+        self._buffers: Dict[Any, SharedVersionedBuffer[K, V]] = {}
+
+    def for_key(self, key: Any) -> SharedVersionedBuffer[K, V]:
+        buffer = self._buffers.get(key)
+        if buffer is None:
+            buffer = SharedVersionedBuffer()
+            self._buffers[key] = buffer
+        return buffer
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self._buffers.values())

@@ -16,7 +16,7 @@ from typing import Any, Callable, Dict, Generic, List, Optional, Sequence as Seq
 from ..pattern.compiler import compile_pattern
 from ..pattern.pattern import Pattern
 from ..state.aggregates import AggregatesStore
-from ..state.buffer import SharedVersionedBuffer
+from ..state.buffer import BufferStore
 from ..state.naming import (
     aggregates_store,
     event_buffer_store,
@@ -52,7 +52,7 @@ class QueryNode(Generic[K, V]):
         self.queried = queried
         self.stores: Dict[str, Any] = {
             nfa_states_store(name): NFAStore(),
-            event_buffer_store(name): SharedVersionedBuffer(),
+            event_buffer_store(name): BufferStore(),
             aggregates_store(name): AggregatesStore(),
         }
         self.processor = CEPProcessor(

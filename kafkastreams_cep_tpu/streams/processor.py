@@ -22,7 +22,7 @@ from ..pattern.compiler import compile_pattern
 from ..pattern.pattern import Pattern
 from ..pattern.stages import Stages
 from ..state.aggregates import AggregatesStore
-from ..state.buffer import SharedVersionedBuffer
+from ..state.buffer import BufferStore
 from ..state.naming import normalize_query_name
 from ..state.nfa_store import NFAStates, NFAStore
 
@@ -38,7 +38,7 @@ class CEPProcessor(Generic[K, V]):
         query_name: str,
         pattern_or_stages: Any,
         nfa_store: Optional[NFAStore] = None,
-        buffer: Optional[SharedVersionedBuffer] = None,
+        buffer: Optional[BufferStore] = None,
         aggregates: Optional[AggregatesStore] = None,
     ) -> None:
         if isinstance(pattern_or_stages, Pattern):
@@ -47,21 +47,22 @@ class CEPProcessor(Generic[K, V]):
             self.stages = pattern_or_stages
         self.query_name = normalize_query_name(query_name)
         self.nfa_store = nfa_store if nfa_store is not None else NFAStore()
-        self.buffer = buffer if buffer is not None else SharedVersionedBuffer()
+        self.buffer = buffer if buffer is not None else BufferStore()
         self.aggregates = aggregates if aggregates is not None else AggregatesStore()
 
     def _load_nfa(self, key: K) -> Tuple[NFA, NFAStates]:
         snapshot = self.nfa_store.find(key)
+        key_buffer = self.buffer.for_key(key)
         if snapshot is not None:
             nfa = NFA(
                 self.aggregates,
-                self.buffer,
+                key_buffer,
                 self.stages.defined_states(),
                 snapshot.computation_stages,
                 snapshot.runs,
             )
             return nfa, snapshot
-        nfa = NFA.build(self.stages, self.aggregates, self.buffer)
+        nfa = NFA.build(self.stages, self.aggregates, key_buffer)
         return nfa, NFAStates(list(nfa.computation_stages), nfa.runs)
 
     def process(

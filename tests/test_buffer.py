@@ -1,6 +1,14 @@
-"""Shared versioned buffer conformance (reference: SharedVersionedBufferTest.java:52-94)."""
-from kafkastreams_cep_tpu import DeweyVersion, Event, Matched, SharedVersionedBuffer
-from kafkastreams_cep_tpu.pattern.stages import Stage, StateType
+"""Shared buffer conformance (reference: SharedVersionedBufferTest.java:52-94).
+
+The store is the exact-lineage redesign (state/buffer.py): chains are linked
+by node id instead of Dewey-routed (stage, event) keys, so the reference
+scenarios translate to parent-linked puts and head-id extraction. The
+assertions -- extracted sequence content, stage order, shared-prefix reuse
+across branches -- are the reference's.
+"""
+import pytest
+
+from kafkastreams_cep_tpu import Event, SharedVersionedBuffer
 
 TOPIC = "topic-test"
 
@@ -10,18 +18,15 @@ ev3 = Event("k3", "v3", 1000000003, TOPIC, 0, 2)
 ev4 = Event("k4", "v4", 1000000004, TOPIC, 0, 3)
 ev5 = Event("k5", "v5", 1000000005, TOPIC, 0, 4)
 
-first = Stage(0, "first", StateType.BEGIN)
-second = Stage(1, "second", StateType.NORMAL)
-latest = Stage(2, "latest", StateType.FINAL)
-
 
 def test_extract_patterns_with_one_run():
+    """Linear put/get (SharedVersionedBufferTest.java:52-66)."""
     buffer = SharedVersionedBuffer()
-    buffer.put(first, ev1, version=DeweyVersion("1"))
-    buffer.put(second, ev2, first, ev1, DeweyVersion("1.0"))
-    buffer.put(latest, ev3, second, ev2, DeweyVersion("1.0.0"))
+    n1 = buffer.put("first", ev1)
+    n2 = buffer.put("second", ev2, n1)
+    n3 = buffer.put("latest", ev3, n2)
 
-    sequence = buffer.get(Matched.from_parts(latest, ev3), DeweyVersion("1.0.0"))
+    sequence = buffer.get(n3)
     assert sequence.size() == 3
     assert sequence.get_by_name("latest").events[0] == ev3
     assert sequence.get_by_name("second").events[0] == ev2
@@ -29,52 +34,70 @@ def test_extract_patterns_with_one_run():
 
 
 def test_extract_patterns_with_branching_run():
+    """Two branches share the (first, second) prefix; each extracts its own
+    lineage (SharedVersionedBufferTest.java:68-86)."""
     buffer = SharedVersionedBuffer()
-    buffer.put(first, ev1, version=DeweyVersion("1"))
-    buffer.put(second, ev2, first, ev1, DeweyVersion("1.0"))
-    buffer.put(latest, ev3, second, ev2, DeweyVersion("1.0.0"))
+    n1 = buffer.put("first", ev1)
+    n2 = buffer.put("second", ev2, n1)
+    head1 = buffer.put("latest", ev3, n2)
 
-    buffer.put(second, ev3, second, ev2, DeweyVersion("1.1"))
-    buffer.put(second, ev4, second, ev3, DeweyVersion("1.1"))
-    buffer.put(latest, ev5, second, ev4, DeweyVersion("1.1.0"))
+    # The branch forks off n2: prefix nodes are stored once.
+    b3 = buffer.put("second", ev3, n2)
+    b4 = buffer.put("second", ev4, b3)
+    head2 = buffer.put("latest", ev5, b4)
 
-    seq1 = buffer.get(Matched.from_parts(latest, ev3), DeweyVersion("1.0.0"))
+    seq1 = buffer.get(head1)
     assert seq1.size() == 3
     assert seq1.get_by_name("latest").events[0] == ev3
     assert seq1.get_by_name("second").events[0] == ev2
     assert seq1.get_by_name("first").events[0] == ev1
 
-    seq2 = buffer.get(Matched.from_parts(latest, ev5), DeweyVersion("1.1.0"))
+    seq2 = buffer.get(head2)
     assert seq2.size() == 5
     assert len(seq2.get_by_name("latest").events) == 1
     assert len(seq2.get_by_name("second").events) == 3
     assert len(seq2.get_by_name("first").events) == 1
 
+    # Shared prefix: 6 puts, 6 nodes -- the fork did not copy (first, ev1)
+    # or (second, ev2).
+    assert len(buffer) == 6
+
 
 def test_stage_order_reversed_on_extract():
     buffer = SharedVersionedBuffer()
-    buffer.put(first, ev1, version=DeweyVersion("1"))
-    buffer.put(second, ev2, first, ev1, DeweyVersion("1.0"))
-    buffer.put(latest, ev3, second, ev2, DeweyVersion("1.0.0"))
+    n1 = buffer.put("first", ev1)
+    n2 = buffer.put("second", ev2, n1)
+    n3 = buffer.put("latest", ev3, n2)
 
-    sequence = buffer.get(Matched.from_parts(latest, ev3), DeweyVersion("1.0.0"))
+    sequence = buffer.get(n3)
     assert [s.stage for s in sequence.matched] == ["first", "second", "latest"]
 
 
-def test_remove_prunes_chain():
-    """Removal walks the chain decrementing refs; interior nodes are written
-    back with the traversed pointer pruned (only the chain-end deletion
-    sticks -- SharedVersionedBufferStoreImpl.java:187-198), leaving them
-    unreferenced and unreachable."""
+def test_put_requires_existing_parent():
     buffer = SharedVersionedBuffer()
-    buffer.put(first, ev1, version=DeweyVersion("1"))
-    buffer.put(second, ev2, first, ev1, DeweyVersion("1.0"))
-    buffer.put(latest, ev3, second, ev2, DeweyVersion("1.0.0"))
+    with pytest.raises(ValueError):
+        buffer.put("second", ev2, 42)
 
+
+def test_gc_reclaims_unreachable_chains_only():
+    """Mark-sweep from live heads: a dead branch is reclaimed, the shared
+    prefix survives as long as a live run reaches it (the lineage analog of
+    refcount removal, SharedVersionedBufferStoreImpl.java:176-201)."""
+    buffer = SharedVersionedBuffer()
+    n1 = buffer.put("first", ev1)
+    n2 = buffer.put("second", ev2, n1)
+    head1 = buffer.put("latest", ev3, n2)
+    head2 = buffer.put("second", ev4, n2)
+    assert len(buffer) == 4
+
+    # head1's run completed (extracted) -> only head2 is live.
+    reclaimed = buffer.gc([head2])
+    assert reclaimed == 1
     assert len(buffer) == 3
-    buffer.remove(Matched.from_parts(latest, ev3), DeweyVersion("1.0.0"))
-    # Every node is left dead: zero refs, empty predecessor lists
-    # (collectible; extraction of this version is no longer possible).
-    for node in buffer._store.values():
-        assert node.refs == 0
-        assert node.predecessors == []
+    seq = buffer.get(head2)
+    assert seq.size() == 3
+    assert seq.get_by_name("first").events[0] == ev1
+
+    # No live heads: everything goes.
+    assert buffer.gc([]) == 3
+    assert len(buffer) == 0
