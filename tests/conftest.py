@@ -21,9 +21,13 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 import jax  # noqa: E402
-from jax._src import xla_bridge as _xb  # noqa: E402
 
 # The axon register hook sets jax_platforms=axon via jax.config at
 # interpreter start, so the env var alone no longer wins.
 jax.config.update("jax_platforms", "cpu")
-_xb._backend_factories.pop("axon", None)
+try:  # private JAX API; guarded so a JAX upgrade degrades gracefully
+    from jax._src import xla_bridge as _xb  # noqa: E402
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:  # pragma: no cover - env-var path still forces cpu
+    pass
