@@ -1,0 +1,386 @@
+#!/usr/bin/env python
+"""Benchmark harness: host-oracle denominator vs device engine throughput.
+
+Measures the BASELINE.md configs (the reference publishes no numbers --
+BASELINE.md documents the absence; the denominator is the host oracle, the
+faithful in-process port of the reference's per-record NFA loop,
+reference: core/.../cep/nfa/NFA.java:134-397):
+
+  1. letters_strict   3-stage strict contiguity A->B->C (SimpleMatcher class)
+  2. stock_rising     one_or_more rising-price stock query, skip_till_next
+  3. skip_any8        8-stage skip_till_any_match + windows (the north-star
+                      config: >=1M events/s, >=20x host)
+  5. highcard         config-1/3 pattern over K batched keys (per-key NFA
+                      instances; the multi-key [T, K] engine)
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": ..., "unit": "events/s", "vs_baseline": ...,
+   "configs": {...per-config detail...}}
+
+`vs_baseline` = batched device throughput / host-oracle throughput on the
+skip_any8 config. Detail per config: host events/s, device single-key
+events/s, batched events/s (engine-only and end-to-end including pack +
+decode), p99 per-batch latency ms, and engine drop counters (all zero in a
+correctly-sized run).
+
+Run on the ambient JAX platform (the real TPU under axon); --cpu forces the
+8-device virtual CPU mesh used by the test suite.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+from typing import Any, Callable, Dict, List
+
+
+def _force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def parse_args() -> argparse.Namespace:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    ap.add_argument(
+        "--quick", action="store_true",
+        help="tiny sizes (CI smoke; numbers not meaningful)",
+    )
+    ap.add_argument(
+        "--configs", default="letters_strict,stock_rising,skip_any8,highcard",
+        help="comma-separated subset to run",
+    )
+    ap.add_argument("--keys", type=int, default=0, help="override batched key count")
+    ap.add_argument("--batch", type=int, default=0, help="override events/key/batch")
+    return ap.parse_args()
+
+
+ARGS = parse_args()
+if ARGS.cpu:
+    _force_cpu()
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+if ARGS.cpu:
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+from kafkastreams_cep_tpu import (  # noqa: E402
+    AggregatesStore,
+    Event,
+    NFA,
+    QueryBuilder,
+    Selected,
+    SharedVersionedBuffer,
+    compile_pattern,
+)
+from kafkastreams_cep_tpu.ops.engine import EngineConfig  # noqa: E402
+from kafkastreams_cep_tpu.ops.runtime import DeviceNFA  # noqa: E402
+from kafkastreams_cep_tpu.ops.schema import EventSchema  # noqa: E402
+from kafkastreams_cep_tpu.ops.tables import compile_query  # noqa: E402
+from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA  # noqa: E402
+from kafkastreams_cep_tpu.pattern.expressions import agg, field, value  # noqa: E402
+
+TS0 = 1_000_000
+
+
+def log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - _T_START:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+_T_START = time.perf_counter()
+
+
+# --------------------------------------------------------------------------
+# Workloads: (pattern, schema, stream generator, engine sizing)
+# --------------------------------------------------------------------------
+def letters_pattern():
+    return (
+        QueryBuilder()
+        .select("select-A").where(value() == "A")
+        .then().select("select-B").where(value() == "B")
+        .then().select("select-C").where(value() == "C")
+        .build()
+    )
+
+
+def letters_stream(rng: random.Random, n: int) -> List[Event]:
+    return [
+        Event("K", rng.choice("ABCD"), TS0 + i, "t", 0, i) for i in range(n)
+    ]
+
+
+def stock_pattern():
+    return (
+        QueryBuilder()
+        .select("stage-1")
+        .where(field("volume") > 1000)
+        .fold("avg", field("price"))
+        .then()
+        .select("stage-2", Selected.with_skip_til_next_match())
+        .zero_or_more()
+        .where(field("price") > agg("avg", default=0))
+        .fold("avg", (agg("avg", default=0) + field("price")) // 2)
+        .fold("volume", field("volume"))
+        .then()
+        .select("stage-3", Selected.with_skip_til_next_match())
+        .where(field("volume") < 0.8 * agg("volume", default=0))
+        .within(ms=64)
+        .build()
+    )
+
+
+def stock_schema() -> EventSchema:
+    return EventSchema({"name": np.int32, "price": np.int32, "volume": np.int32})
+
+
+def stock_stream(rng: random.Random, n: int) -> List[Event]:
+    out = []
+    for i in range(n):
+        v = {
+            "name": "s",
+            "price": rng.randint(80, 140),
+            "volume": rng.randint(500, 1500),
+        }
+        out.append(Event("K", v, TS0 + i, "t", 0, i))
+    return out
+
+
+SKIP_ANY_STAGES = "ABCDEFGH"   # 8 stage letters
+SKIP_ANY_NOISE = "QRSTUV"      # noise letters only the IGNORE edges see
+
+
+def skip_any8_pattern():
+    """8 stages, stages 2-8 skip-till-any. The first stage stays on the
+    default strategy: a skip-strategy BEGIN state is unsound in the reference
+    itself (its IGNORE re-add + unconditional begin re-add duplicate the
+    begin run every event, NFA.java:272-285,323-338 -- behavior our oracle
+    reproduces for conformance)."""
+    qb = QueryBuilder()
+    builder = qb.select("s0").where(value() == SKIP_ANY_STAGES[0])
+    for i in range(1, 8):
+        builder = (
+            builder.then()
+            .select(f"s{i}", Selected.with_skip_til_any_match())
+            .where(value() == SKIP_ANY_STAGES[i])
+        )
+    return builder.within(ms=8).build()
+
+
+def skip_any8_stream(rng: random.Random, n: int) -> List[Event]:
+    """Ordered stage-letter bursts with trailing noise (the SASE shape):
+    each 16-event block opens with A..H consecutively, so full chains
+    complete inside the 8ms window; skip-till-any doubling (2^7 runs per
+    lineage) expires at the window edge, bounding steady-state lanes."""
+    letters: List[str] = []
+    while len(letters) < n:
+        letters.extend(SKIP_ANY_STAGES)
+        letters.extend(rng.choice(SKIP_ANY_NOISE) for _ in range(8))
+    return [
+        Event("K", letters[i], TS0 + i, "t", 0, i) for i in range(n)
+    ]
+
+
+WORKLOADS: Dict[str, Dict[str, Any]] = {
+    "letters_strict": dict(
+        pattern=letters_pattern, schema=None, stream=letters_stream,
+        config=EngineConfig(lanes=8, nodes=4096, matches=512),
+    ),
+    "stock_rising": dict(
+        pattern=stock_pattern, schema=stock_schema, stream=stock_stream,
+        config=EngineConfig(lanes=256, nodes=32768, matches=2048),
+    ),
+    "skip_any8": dict(
+        pattern=skip_any8_pattern, schema=None, stream=skip_any8_stream,
+        config=EngineConfig(lanes=1024, nodes=32768, matches=2048, strict_windows=True),
+        strict=True,
+    ),
+}
+
+
+# --------------------------------------------------------------------------
+# Measurement
+# --------------------------------------------------------------------------
+def bench_host(
+    pattern_fn: Callable, stream: List[Event], budget_s: float,
+    strict_windows: bool = False,
+) -> Dict[str, Any]:
+    """Host oracle (the >=20x denominator): pure per-record NFA loop."""
+    stages = compile_pattern(pattern_fn())
+    nfa = NFA.build(
+        stages, AggregatesStore(), SharedVersionedBuffer(),
+        strict_windows=strict_windows,
+    )
+    n_matches = 0
+    n = 0
+    t0 = time.perf_counter()
+    deadline = t0 + budget_s
+    for e in stream:
+        n_matches += len(nfa.match_pattern(e))
+        n += 1
+        if time.perf_counter() > deadline:
+            break
+    dt = time.perf_counter() - t0
+    return dict(events=n, seconds=dt, eps=n / dt, matches=n_matches)
+
+
+def bench_device_single(
+    pattern_fn: Callable, schema_fn, stream: List[Event],
+    config: EngineConfig, batch: int, n_batches: int,
+) -> Dict[str, Any]:
+    """Single-key DeviceNFA: scan-per-batch, decode each batch."""
+    schema = schema_fn() if schema_fn else None
+    dev = DeviceNFA(
+        compile_query(compile_pattern(pattern_fn()), schema),
+        config=config, gc_every=1,
+    )
+    # Warmup compiles the step/GC programs.
+    dev.advance(stream[:batch])
+    t0 = time.perf_counter()
+    n = 0
+    n_matches = 0
+    for b in range(1, n_batches):
+        chunk = stream[b * batch: (b + 1) * batch]
+        if len(chunk) < batch:
+            break
+        n_matches += len(dev.advance(chunk))
+        n += len(chunk)
+    jax.block_until_ready(dev.state["n_events"])
+    dt = time.perf_counter() - t0
+    stats = dev.stats
+    return dict(
+        events=n, seconds=dt, eps=n / dt, matches=n_matches,
+        lane_drops=stats["lane_drops"], node_drops=stats["node_drops"],
+        match_drops=stats["match_drops"],
+    )
+
+
+def bench_device_batched(
+    pattern_fn: Callable, schema_fn, stream_fn: Callable,
+    config: EngineConfig, n_keys: int, batch: int, n_batches: int,
+) -> Dict[str, Any]:
+    """Multi-key batched engine: the throughput path.
+
+    Engine-only timing pre-packs every [T, K] batch (ingest packing is a
+    pipelined host-side stage -- measured separately as end2end).
+    """
+    schema = schema_fn() if schema_fn else None
+    query = compile_query(compile_pattern(pattern_fn()), schema)
+    bat = BatchedDeviceNFA(
+        query, keys=[f"k{i}" for i in range(n_keys)], config=config, gc_every=1
+    )
+    rng = random.Random(7)
+    streams = {k: stream_fn(rng, batch * n_batches) for k in bat.keys}
+
+    t_pack0 = time.perf_counter()
+    packed = [
+        bat.pack({k: s[b * batch: (b + 1) * batch] for k, s in streams.items()})
+        for b in range(n_batches)
+    ]
+    pack_s = time.perf_counter() - t_pack0
+
+    bat.advance_packed(packed[0], decode=False)  # warmup compile
+    jax.block_until_ready(bat.state["n_events"])
+
+    lat_ms: List[float] = []
+    n_matches = 0
+    t0 = time.perf_counter()
+    for xs in packed[1:]:
+        tb = time.perf_counter()
+        out = bat.advance_packed(xs, decode=True)
+        n_matches += sum(len(v) for v in out.values())
+        jax.block_until_ready(bat.state["n_events"])
+        lat_ms.append((time.perf_counter() - tb) * 1e3)
+    dt = time.perf_counter() - t0
+    n = (len(packed) - 1) * batch * n_keys
+    stats = bat.stats
+    return dict(
+        events=n, seconds=dt, eps=n / dt, matches=n_matches,
+        keys=n_keys, batch=batch, lanes=config.lanes,
+        pack_eps=n / pack_s * (len(packed) - 1) / len(packed),
+        p50_batch_ms=float(np.percentile(lat_ms, 50)),
+        p99_batch_ms=float(np.percentile(lat_ms, 99)),
+        lane_drops=stats["lane_drops"], node_drops=stats["node_drops"],
+        match_drops=stats["match_drops"],
+    )
+
+
+def main() -> None:
+    quick = ARGS.quick
+    which = [c.strip() for c in ARGS.configs.split(",") if c.strip()]
+    platform = jax.devices()[0].platform
+    detail: Dict[str, Any] = {}
+
+    host_events = 2_000 if quick else 50_000
+    host_budget = 2.0 if quick else 10.0
+    batch = ARGS.batch or (32 if quick else 256)
+    n_batches = 3 if quick else 12
+
+    for name in which:
+        if name == "highcard":
+            continue
+        wl = WORKLOADS[name]
+        rng = random.Random(11)
+        stream = wl["stream"](rng, max(host_events, batch * n_batches))
+        log(f"{name}: host oracle ({host_events} events, {host_budget}s budget)")
+        host = bench_host(
+            wl["pattern"], stream[:host_events], host_budget,
+            strict_windows=wl.get("strict", False),
+        )
+        log(f"{name}: host {host['eps']:.0f} ev/s; device single-key")
+        dev = bench_device_single(
+            wl["pattern"], wl["schema"], stream, wl["config"], batch, n_batches
+        )
+        log(f"{name}: device single {dev['eps']:.0f} ev/s")
+        detail[name] = dict(host=host, device_single=dev)
+
+    # Config 5 / headline: batched high-cardinality keys.
+    if "highcard" in which or "skip_any8" in which:
+        n_keys = ARGS.keys or (8 if quick else 256)
+        bb = ARGS.batch or (16 if quick else 64)
+        nb = 3 if quick else 8
+        log(f"skip_any8_batched: K={n_keys} T={bb}")
+        batched = bench_device_batched(
+            skip_any8_pattern, None, skip_any8_stream,
+            EngineConfig(lanes=512, nodes=16384, matches=512, strict_windows=True),
+            n_keys, bb, nb,
+        )
+        detail["skip_any8_batched"] = batched
+        log(f"skip_any8_batched: {batched['eps']:.0f} ev/s; highcard letters")
+        hc = bench_device_batched(
+            letters_pattern, None, letters_stream,
+            EngineConfig(lanes=8, nodes=2048, matches=256),
+            (ARGS.keys or (8 if quick else 4096)), bb, nb,
+        )
+        detail["highcard_letters_batched"] = hc
+
+    headline = detail.get("skip_any8_batched", {}).get("eps", 0.0)
+    denom = detail.get("skip_any8", {}).get("host", {}).get("eps", 0.0)
+    out = {
+        "metric": "events_per_sec_skip_any8_batched",
+        "value": round(headline, 1),
+        "unit": "events/s",
+        "vs_baseline": round(headline / denom, 2) if denom else None,
+        "platform": platform,
+        "quick": quick,
+        "configs": detail,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

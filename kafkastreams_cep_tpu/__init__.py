@@ -28,6 +28,29 @@ from .streams.serde import Queried, sequence_to_json
 
 __version__ = "0.1.0"
 
+#: Device-path API, resolved lazily so importing the package does not pull
+#: in jax for host-only use (the streams layer imports these on demand).
+_DEVICE_EXPORTS = {
+    "DeviceNFA": ("kafkastreams_cep_tpu.ops.runtime", "DeviceNFA"),
+    "BatchedDeviceNFA": ("kafkastreams_cep_tpu.parallel", "BatchedDeviceNFA"),
+    "DeviceCEPProcessor": (
+        "kafkastreams_cep_tpu.streams.device_processor",
+        "DeviceCEPProcessor",
+    ),
+    "EngineConfig": ("kafkastreams_cep_tpu.ops.engine", "EngineConfig"),
+    "EventSchema": ("kafkastreams_cep_tpu.ops.schema", "EventSchema"),
+    "compile_query": ("kafkastreams_cep_tpu.ops.tables", "compile_query"),
+}
+
+
+def __getattr__(name: str):
+    target = _DEVICE_EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target[0]), target[1])
+
 __all__ = [
     "DeweyVersion",
     "Event",
@@ -64,4 +87,11 @@ __all__ = [
     "CEPProcessor",
     "Queried",
     "sequence_to_json",
+    # lazy device-path exports
+    "DeviceNFA",
+    "BatchedDeviceNFA",
+    "DeviceCEPProcessor",
+    "EngineConfig",
+    "EventSchema",
+    "compile_query",
 ]

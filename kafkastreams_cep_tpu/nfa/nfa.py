@@ -115,24 +115,36 @@ class NFA(Generic[K, V]):
         aggregates_names: Set[str],
         computation_stages: List[ComputationStage[K, V]],
         runs: int = 1,
+        strict_windows: bool = False,
     ) -> None:
         self.aggregates_store = aggregates_store
         self.buffer = buffer
         self.aggregates_names = set(aggregates_names)
         self.computation_stages: List[ComputationStage[K, V]] = list(computation_stages)
         self.runs = runs
+        # Reference parity (False): synthesized epsilon stages carry no window
+        # (Stage.java:247-251 never copies windowMs, DEFAULT_WINDOW_MS=-1 at
+        # Stage.java:42), so any run that has consumed an event -- which always
+        # sits at an epsilon stage -- is never expired, run populations grow
+        # without bound under skip-till-any, and matches can span longer than
+        # within(). strict_windows=True fixes that documented reference leak:
+        # epsilon stages inherit the descent target's window and expiry keys
+        # off "has consumed an event" instead of "is not the begin stage".
+        self.strict_windows = strict_windows
 
     @staticmethod
     def build(
         stages: Stages,
         aggregates_store: AggregatesStore,
         buffer: SharedVersionedBuffer,
+        strict_windows: bool = False,
     ) -> "NFA":
         return NFA(
             aggregates_store,
             buffer,
             stages.defined_states(),
             [initial_computation_stage(stages)],
+            strict_windows=strict_windows,
         )
 
     # ------------------------------------------------------------------ API
@@ -171,9 +183,30 @@ class NFA(Generic[K, V]):
     def _match_computation(
         self, computation: ComputationStage[K, V], event: Event[K, V]
     ) -> List[ComputationStage[K, V]]:
-        if not computation.is_begin_state and computation.is_out_of_window(event.timestamp):
+        if self.strict_windows:
+            # Expire any run that has consumed an event (timestamp set); the
+            # begin run itself (timestamp -1) has nothing to expire.
+            expired = computation.timestamp >= 0 and computation.is_out_of_window(
+                event.timestamp
+            )
+        else:
+            # Reference parity (NFA.java:183-184): begin-typed queue items --
+            # including the epsilon state a consumed begin run sits at -- are
+            # exempt, and epsilon stages carry no window at all.
+            expired = not computation.is_begin_state and computation.is_out_of_window(
+                event.timestamp
+            )
+        if expired:
             return []
         return self._evaluate(computation, event, computation.stage, None)
+
+    def _new_epsilon(self, current: Stage, target: Stage) -> Stage:
+        eps = Stage.new_epsilon(current, target)
+        if self.strict_windows:
+            eps.window_ms = (
+                target.window_ms if target.window_ms != -1 else current.window_ms
+            )
+        return eps
 
     def _matched_edges(
         self,
@@ -270,7 +303,7 @@ class NFA(Generic[K, V]):
                 consumed_node = self.buffer.put(current_stage.name, event, previous_node)
                 next_stages.append(
                     ComputationStage(
-                        stage=Stage.new_epsilon(current_stage, current_stage),
+                        stage=self._new_epsilon(current_stage, current_stage),
                         version=version,
                         sequence=sequence_id,
                         last_event=event,
@@ -284,7 +317,7 @@ class NFA(Generic[K, V]):
                 consumed_node = self.buffer.put(current_stage.name, event, previous_node)
                 next_stages.append(
                     ComputationStage(
-                        stage=Stage.new_epsilon(current_stage, edge.target),
+                        stage=self._new_epsilon(current_stage, edge.target),
                         version=version,
                         sequence=sequence_id,
                         last_event=event,
@@ -305,12 +338,12 @@ class NFA(Generic[K, V]):
                 last_event = previous_event if ignored else event
                 prev_is_begin = previous_stage is not None and previous_stage.is_begin
                 if previous_stage is not None:
-                    branch_stage = Stage.new_epsilon(previous_stage, current_stage)
+                    branch_stage = self._new_epsilon(previous_stage, current_stage)
                 else:
                     # Begin-stage branching (untestable in the reference:
                     # NFA.java:293 would NPE); park the clone at the current
                     # stage itself.
-                    branch_stage = Stage.new_epsilon(current_stage, current_stage)
+                    branch_stage = self._new_epsilon(current_stage, current_stage)
                     prev_is_begin = True
                 run_offset = 2 if (prev_is_begin and len(version.digits) >= 2) else 1
                 next_version = version.add_run(run_offset)

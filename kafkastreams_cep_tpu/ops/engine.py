@@ -77,6 +77,13 @@ class EngineConfig:
     nodes: int = 8192        # buffer node pool per key per batch window
     matches: int = 1024      # match-descriptor ring per batch
     digits: int = 0          # Dewey digit width; 0 = auto (n_stages + 2)
+    #: Reference parity (False): synthesized epsilon stages carry no window
+    #: (Stage.java:247-251,42), so consumed runs are never expired and
+    #: skip-till-any run populations grow without bound. True = epsilon runs
+    #: inherit their descent target's window and any run with a consumed
+    #: event (ts >= 0) expires -- the bounded-memory mode (matches the host
+    #: oracle's NFA(strict_windows=True)).
+    strict_windows: bool = False
 
     def dewey_width(self, query: CompiledQuery) -> int:
         return self.digits if self.digits > 0 else query.n_stages + 2
@@ -229,10 +236,24 @@ def build_step(
             return got & (pid >= 0)
 
         # -- window expiry (NFA.java:183-184; begin states never expire, and
-        # synthesized epsilon stages carry no window, Stage.java:247-251) ----
+        # synthesized epsilon stages carry no window, Stage.java:247-251;
+        # strict_windows inherits the target's window instead -- see
+        # EngineConfig.strict_windows) -----------------------------------
         root_begin = t_is_begin[src]
-        eff_window = jnp.where(eps >= 0, -1, t_window[src])
-        expired = active & ~root_begin & (eff_window >= 0) & ((ev_ts - lane_ts) > eff_window)
+        if config.strict_windows:
+            w_eps = t_window[eps.clip(0)]
+            w_eps = jnp.where(w_eps >= 0, w_eps, t_window[src])
+            eff_window = jnp.where(eps >= 0, w_eps, t_window[src])
+            expired = (
+                active & (lane_ts >= 0) & (eff_window >= 0)
+                & ((ev_ts - lane_ts) > eff_window)
+            )
+        else:
+            eff_window = jnp.where(eps >= 0, -1, t_window[src])
+            expired = (
+                active & ~root_begin & (eff_window >= 0)
+                & ((ev_ts - lane_ts) > eff_window)
+            )
         active = active & ~expired
 
         root_fwd = (eps >= 0) | t_is_fwd[src]
@@ -584,6 +605,70 @@ def build_step(
     return step
 
 
+def build_gc(config: EngineConfig):
+    """Device mark-sweep compaction of the buffer node pool (single key).
+
+    The host-native analog of the reference's refcount GC
+    (SharedVersionedBufferStoreImpl.java:176-201) re-designed write-free for
+    the hot path: nodes reachable from any live lane's `node` chain are kept
+    and compacted to the front of the pool; everything else is freed. The
+    whole pass runs on device (a `lax.while_loop` predecessor walk over all
+    lanes at once + prefix-sum scatter), so no pool bytes cross the host
+    boundary. vmap-able over a leading key axis.
+    """
+    B = config.nodes
+
+    def gc(state: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        node_pred = state["node_pred"]
+        lane_node = jnp.where(state["active"], state["node"], -1)
+
+        def cond(carry):
+            _, cur = carry
+            return jnp.any(cur >= 0)
+
+        def body(carry):
+            marked, cur = carry
+            live = cur >= 0
+            # Dead cursors route to the trash slot B so their writes cannot
+            # clobber slot 0 (duplicate-index .set is last-write-wins).
+            cidx = jnp.where(live, cur, B)
+            seen = marked[cidx] & live
+            marked = marked.at[cidx].set(True)
+            cur = jnp.where(live & ~seen, node_pred[cidx], -1)
+            return marked, cur
+
+        marked, _ = jax.lax.while_loop(
+            cond, body, (jnp.zeros(B + 1, bool), lane_node)
+        )
+        keep = marked[:B]
+        pos = _excl_cumsum(keep)
+        remap = jnp.where(keep, pos, -1).astype(jnp.int32)  # old idx -> new
+        idx_new = jnp.where(keep, pos, B)
+
+        def scatter(vals: jnp.ndarray, fill) -> jnp.ndarray:
+            out = jnp.full(B + 1, fill, vals.dtype)
+            out = out.at[idx_new].set(jnp.where(keep, vals, fill), mode="drop")
+            return out.at[B].set(fill)
+
+        # Index domain of stored node pointers is [-1, B] (B = trash slot).
+        remap_full = jnp.concatenate([remap, jnp.full(1, -1, jnp.int32)])
+        pred_b = node_pred[:B]
+        pred_remapped = jnp.where(pred_b >= 0, remap_full[pred_b.clip(0)], -1)
+        new_lane = jnp.where(
+            state["node"] >= 0, remap_full[state["node"].clip(0)], -1
+        )
+        return {
+            **state,
+            "node_event": scatter(state["node_event"][:B], -1),
+            "node_name": scatter(state["node_name"][:B], -1),
+            "node_pred": scatter(pred_remapped, -1),
+            "node_count": jnp.sum(keep).astype(jnp.int32),
+            "node": new_lane.astype(jnp.int32),
+        }
+
+    return gc
+
+
 def build_batch_fn(query: CompiledQuery, config: EngineConfig):
     """jit-compiled batch advance: scan the one-event step over [T] columns.
 
@@ -603,8 +688,12 @@ def build_batch_fn(query: CompiledQuery, config: EngineConfig):
 
 def eval_stateless_preds(query: CompiledQuery, cols: Dict[str, np.ndarray]) -> jnp.ndarray:
     """Evaluate all stateless predicates over the whole batch: one fused
-    vectorized pass per predicate (the [T, P] mask precompute)."""
-    T = len(cols["ts"])
+    vectorized pass per predicate (the [T, P] mask precompute).
+
+    Column leaves may be [T] (single key) or [T, K] (batched multi-key); the
+    predicate axis is appended last, so the result is [T, P] or [T, K, P].
+    """
+    shape = np.shape(cols["ts"])
     env = DeviceEnv(
         {k: jnp.asarray(v) for k, v in cols.items()},
         jnp.zeros((1, query.n_aggs), jnp.float32),
@@ -615,8 +704,8 @@ def eval_stateless_preds(query: CompiledQuery, cols: Dict[str, np.ndarray]) -> j
     out = []
     for p in range(max(query.n_preds, 1)):
         if p < query.n_preds and not query.pred_stateful[p]:
-            v = jnp.broadcast_to(jnp.asarray(query.predicates[p](env), bool), (T,))
+            v = jnp.broadcast_to(jnp.asarray(query.predicates[p](env), bool), shape)
         else:
-            v = jnp.zeros(T, bool)  # stateful: evaluated in-step per lane
+            v = jnp.zeros(shape, bool)  # stateful: evaluated in-step per lane
         out.append(v)
-    return jnp.stack(out, axis=1)
+    return jnp.stack(out, axis=-1)

@@ -27,7 +27,15 @@ import jax.numpy as jnp
 from ..core.event import Event
 from ..core.sequence import Sequence, SequenceBuilder
 from ..pattern.stages import Stages
-from .engine import EngineConfig, build_batch_fn, eval_stateless_preds, init_state
+import jax
+
+from .engine import (
+    EngineConfig,
+    build_batch_fn,
+    build_gc,
+    eval_stateless_preds,
+    init_state,
+)
 from .schema import EventSchema
 from .tables import CompiledQuery, compile_query
 
@@ -45,6 +53,7 @@ class DeviceNFA:
         stages_or_query: Any,
         schema: Optional[EventSchema] = None,
         config: Optional[EngineConfig] = None,
+        gc_every: int = 1,
     ) -> None:
         if isinstance(stages_or_query, CompiledQuery):
             self.query = stages_or_query
@@ -53,10 +62,13 @@ class DeviceNFA:
             self.query = compile_query(stages_or_query, schema)
         self.config = config if config is not None else EngineConfig()
         self._advance = build_batch_fn(self.query, self.config)
+        self._gc = jax.jit(build_gc(self.config))
+        self.gc_every = max(1, gc_every)
         self.state = init_state(self.query, self.config)
         self._events: Dict[int, Event] = {}
         self._next_gidx = 0
         self._ts_base: Optional[int] = None
+        self._batches = 0
 
     # ------------------------------------------------------------------ API
     @property
@@ -119,7 +131,10 @@ class DeviceNFA:
         xs = self._pack(events)
         self.state = self._advance(self.state, xs)
         matches = self._decode_matches()
-        self._compact()
+        self._batches += 1
+        if self._batches % self.gc_every == 0:
+            self.state = self._gc(self.state)
+            self._prune_events()
         return matches
 
     # ------------------------------------------------------------ internals
@@ -152,66 +167,131 @@ class DeviceNFA:
         node_event = np.asarray(self.state["node_event"])
         node_name = np.asarray(self.state["node_name"])
         node_pred = np.asarray(self.state["node_pred"])
-        names = self.query.name_of_id
 
-        out: List[Sequence] = []
-        for node in match_node:
-            builder: SequenceBuilder = SequenceBuilder()
-            idx = int(node)
-            while idx >= 0:
-                builder.add(names[int(node_name[idx])], self._events[int(node_event[idx])])
-                idx = int(node_pred[idx])
-            out.append(builder.build(reversed_=True))
+        chains = decode_chains(match_node, node_name, node_event, node_pred)
+        out = [
+            materialize_sequence(chain, self.query.name_of_id, self._events)
+            for chain in chains
+        ]
 
         # Drain the ring.
         self.state["match_count"] = jnp.asarray(0, np.int32)
         self.state["match_node"] = jnp.full_like(self.state["match_node"], -1)
         return out
 
-    def _compact(self) -> None:
-        """Mark-sweep the node pool: keep chains reachable from live lanes."""
-        count = int(self.state["node_count"])
-        if count == 0:
-            return
-        active = np.asarray(self.state["active"])
-        lane_node = np.asarray(self.state["node"])
-        node_pred = np.asarray(self.state["node_pred"])[: count]
-        node_event = np.asarray(self.state["node_event"])[: count]
-        node_name = np.asarray(self.state["node_name"])[: count]
-
-        marked = np.zeros(count, bool)
-        for i in range(len(active)):
-            if not active[i]:
-                continue
-            idx = int(lane_node[i])
-            while idx >= 0 and not marked[idx]:
-                marked[idx] = True
-                idx = int(node_pred[idx])
-        kept = np.flatnonzero(marked)
-        if len(kept) == count:
-            return
-        remap = np.full(count + 1, -1, np.int32)
-        remap[kept] = np.arange(len(kept), dtype=np.int32)
-
-        B = len(np.asarray(self.state["node_pred"])) - 1
-        new_event = np.full(B + 1, -1, np.int32)
-        new_name = np.full(B + 1, -1, np.int32)
-        new_pred = np.full(B + 1, -1, np.int32)
-        new_event[: len(kept)] = node_event[kept]
-        new_name[: len(kept)] = node_name[kept]
-        # Predecessors of kept nodes are kept too (chains are marked whole).
-        pred_of_kept = node_pred[kept]
-        new_pred[: len(kept)] = np.where(
-            pred_of_kept >= 0, remap[pred_of_kept.clip(0)], -1
+    # --------------------------------------------------------- checkpointing
+    def snapshot(self) -> bytes:
+        """Serialize the full engine state to bytes (device arrays pulled as
+        raw typed frames + the host event registry). The device analog of
+        the reference's per-record NFAStates externalization
+        (CEPProcessor.java:144-147), taken at batch granularity."""
+        from ..state.serde import (
+            _Writer,
+            MAGIC,
+            encode_array_tree,
+            encode_event_registry,
         )
-        new_lane_node = np.where(lane_node >= 0, remap[lane_node.clip(0, count)], -1)
 
-        self.state["node_event"] = jnp.asarray(new_event)
-        self.state["node_name"] = jnp.asarray(new_name)
-        self.state["node_pred"] = jnp.asarray(new_pred)
-        self.state["node_count"] = jnp.asarray(len(kept), np.int32)
-        self.state["node"] = jnp.asarray(new_lane_node.astype(np.int32))
+        w = _Writer()
+        w._buf.write(MAGIC)
+        w.blob(encode_array_tree({k: np.asarray(v) for k, v in self.state.items()}))
+        w.blob(encode_event_registry(self._events))
+        w.i64(self._next_gidx)
+        w.i64(self._ts_base if self._ts_base is not None else -1)
+        w.i64(self._batches)
+        return w.getvalue()
 
-        # Prune the event registry to events still referenced by the pool.
-        live_gidx = set(int(g) for g in new_event[: len(kept)] if g >= 0)
+    @classmethod
+    def restore(
+        cls,
+        stages_or_query: Any,
+        data: bytes,
+        schema: Optional[EventSchema] = None,
+        config: Optional[EngineConfig] = None,
+        gc_every: int = 1,
+    ) -> "DeviceNFA":
+        """Rebuild a DeviceNFA from `snapshot()` bytes in a fresh object
+        graph (query recompiled by the caller, stages never serialized --
+        the ComputationStageSerde.java:56-66 contract)."""
+        from ..state.serde import (
+            _Reader,
+            MAGIC,
+            decode_array_tree,
+            decode_event_registry,
+        )
+
+        dev = cls(stages_or_query, schema=schema, config=config, gc_every=gc_every)
+        r = _Reader(data)
+        if r._read(4) != MAGIC:
+            raise ValueError("bad checkpoint magic")
+        tree = decode_array_tree(r.blob())
+        dev.state = {k: jnp.asarray(v) for k, v in tree.items()}
+        dev._events = decode_event_registry(r.blob())
+        dev._next_gidx = r.i64()
+        ts_base = r.i64()
+        dev._ts_base = None if ts_base < 0 else ts_base
+        dev._batches = r.i64()
+        return dev
+
+    def _prune_events(self) -> None:
+        """Bound the host event registry: keep only pool-referenced events.
+
+        Runs after the on-device GC (engine.build_gc) compacted the pool, so
+        the single [B+1] `node_event` pull is the only host transfer.
+        """
+        count = int(self.state["node_count"])
+        if len(self._events) <= count:
+            return
+        live = np.asarray(self.state["node_event"])[:count]
+        live_gidx = set(int(g) for g in live[live >= 0])
         self._events = {g: e for g, e in self._events.items() if g in live_gidx}
+
+
+def decode_chains(
+    start_nodes: np.ndarray,
+    node_name: np.ndarray,
+    node_event: np.ndarray,
+    node_pred: np.ndarray,
+) -> List[List[Tuple[int, int]]]:
+    """Vectorized predecessor walk: all match chains at once.
+
+    Replaces the per-match, per-node Python walk with one NumPy gather per
+    chain *depth* level (the host analog of the reference's peek loop,
+    SharedVersionedBufferStoreImpl.java:176-201). Returns, per start node,
+    the chain as (stage-name-id, event-gidx) pairs oldest-first.
+    """
+    n = len(start_nodes)
+    cur = start_nodes.astype(np.int64)
+    midx = np.arange(n)
+    levels: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+    while True:
+        live = cur >= 0
+        if not live.any():
+            break
+        li = cur[live]
+        levels.append((midx[live], node_name[li], node_event[li]))
+        nxt = np.full_like(cur, -1)
+        nxt[live] = node_pred[li]
+        cur = nxt
+
+    chains: List[List[Tuple[int, int]]] = [[] for _ in range(n)]
+    for m_ids, names_l, gidxs in reversed(levels):
+        for m, nm, g in zip(m_ids.tolist(), names_l.tolist(), gidxs.tolist()):
+            if g < 0:
+                # Dropped put (node-pool overflow routed to the trash slot):
+                # the chain is truncated; node_drops already counts it.
+                continue
+            chains[m].append((nm, g))
+    return chains
+
+
+def materialize_sequence(
+    chain: List[Tuple[int, int]],
+    name_of_id: List[str],
+    events: Dict[int, Event],
+) -> Sequence:
+    """Build a host `Sequence` from an oldest-first (name-id, gidx) chain."""
+    builder: SequenceBuilder = SequenceBuilder()
+    for name_id, gidx in chain:
+        builder.add(name_of_id[name_id], events[gidx])
+    return builder.build()

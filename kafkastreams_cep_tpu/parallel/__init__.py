@@ -1,0 +1,25 @@
+"""Key-axis parallelism: vmapped multi-key engine + mesh sharding."""
+
+from .batched import BatchedDeviceNFA
+from .key_shard import (
+    KEY_AXIS,
+    build_batched_advance,
+    global_stats,
+    init_batched_state,
+    key_mesh,
+    key_sharding,
+    shard_state,
+    shard_xs,
+)
+
+__all__ = [
+    "BatchedDeviceNFA",
+    "KEY_AXIS",
+    "build_batched_advance",
+    "global_stats",
+    "init_batched_state",
+    "key_mesh",
+    "key_sharding",
+    "shard_state",
+    "shard_xs",
+]

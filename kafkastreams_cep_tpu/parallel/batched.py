@@ -1,0 +1,361 @@
+"""Multi-key batched device driver: thousands of per-key NFAs per chip.
+
+The reference scales by Kafka partitioning -- one stream task per partition,
+one NFA object per record key, advanced record-at-a-time
+(reference: core/.../cep/processor/CEPProcessor.java:111-124,139). The
+TPU-native design packs K keys' event columns into [T, K] micro-batches and
+drives the vmapped transition kernel (parallel/key_shard.py) so one chip
+advances every key's NFA in lockstep; the key axis shards across a
+`jax.sharding.Mesh` for multi-chip scale-out with no collectives on the
+per-event hot path (SURVEY.md section 2.8).
+
+Host responsibilities mirror the single-key runtime (ops/runtime.py): SoA
+packing through the query's EventSchema, a global (gidx -> Event) registry,
+vectorized match decode across all keys at once, and on-device mark-sweep
+pool GC at a configurable cadence.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence as Seq, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.event import Event
+from ..core.sequence import Sequence
+from ..ops.engine import EngineConfig, build_gc, eval_stateless_preds, init_state
+from ..ops.runtime import decode_chains, materialize_sequence
+from ..ops.schema import EventSchema
+from ..ops.tables import CompiledQuery, compile_query
+from ..pattern.stages import Stages
+from .key_shard import (
+    build_batched_advance,
+    init_batched_state,
+    key_sharding,
+    shard_state,
+    shard_xs,
+)
+
+
+class BatchedDeviceNFA:
+    """K independent per-key NFAs advanced as one [T, K] device program.
+
+    `keys` fixes the lane->key mapping for the instance's lifetime (the
+    driver layer above assigns keys to lanes; see streams/device_processor).
+    With `mesh` set, engine state and event columns shard along the key axis
+    over the mesh's devices.
+    """
+
+    def __init__(
+        self,
+        stages_or_query: Any,
+        keys: Seq[Any],
+        schema: Optional[EventSchema] = None,
+        config: Optional[EngineConfig] = None,
+        mesh: Optional[Any] = None,
+        gc_every: int = 1,
+        events_prune_threshold: int = 1 << 16,
+    ) -> None:
+        if isinstance(stages_or_query, CompiledQuery):
+            self.query = stages_or_query
+        else:
+            assert isinstance(stages_or_query, Stages)
+            self.query = compile_query(stages_or_query, schema)
+        self.config = config if config is not None else EngineConfig()
+        self.mesh = mesh
+        self.keys: List[Any] = list(keys)
+        if not self.keys:
+            raise ValueError("BatchedDeviceNFA needs at least one key")
+        # Pad the key axis to a multiple of the mesh extent so the shard is
+        # even; padding lanes never receive valid events.
+        self.K = len(self.keys)
+        k_pad = self.K
+        if mesh is not None:
+            n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+            k_pad = ((self.K + n_dev - 1) // n_dev) * n_dev
+        self.K_padded = k_pad
+        self.key_index: Dict[Any, int] = {k: i for i, k in enumerate(self.keys)}
+
+        self.state = init_batched_state(self.query, self.config, self.K_padded)
+        if mesh is not None:
+            self.state = shard_state(self.state, mesh)
+        self._advance = build_batched_advance(self.query, self.config)
+        self._gc = jax.jit(jax.vmap(build_gc(self.config)))
+        self._drain = jax.jit(_drain_match_ring)
+        self.gc_every = max(1, gc_every)
+        self.events_prune_threshold = events_prune_threshold
+        self._events: Dict[int, Event] = {}
+        self._next_gidx = 0
+        #: highest gidx already advanced through the engine; events above it
+        #: were packed ahead (pipelined ingest) and must survive pruning.
+        self._processed_gidx = -1
+        self._ts_base: Optional[int] = None
+        self._batches = 0
+        self._stats_fn = None
+
+    # ------------------------------------------------------------------ API
+    def add_keys(self, new_keys: Seq[Any]) -> None:
+        """Grow the key axis: fresh per-key engine state for each new key.
+
+        The jitted advance/GC retrace for the new [K] extent (shape change),
+        so callers should grow geometrically (see streams/device_processor).
+        """
+        for k in new_keys:
+            if k in self.key_index:
+                raise KeyError(f"key {k!r} already assigned")
+        self.keys.extend(new_keys)
+        self.K = len(self.keys)
+        k_pad = self.K
+        if self.mesh is not None:
+            n_dev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
+            k_pad = ((self.K + n_dev - 1) // n_dev) * n_dev
+        delta = k_pad - self.K_padded
+        self.key_index = {k: i for i, k in enumerate(self.keys)}
+        if delta > 0:
+            fresh = init_batched_state(self.query, self.config, delta)
+            self.state = jax.tree.map(
+                lambda old, new: jnp.concatenate([old, new], axis=0),
+                self.state,
+                fresh,
+            )
+            self.K_padded = k_pad
+            if self.mesh is not None:
+                self.state = shard_state(self.state, self.mesh)
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """Cross-key counter totals: one fused reduction + one host pull
+        (key_shard.global_stats; an ICI all-reduce when sharded)."""
+        from .key_shard import global_stats
+
+        if self._stats_fn is None:
+            self._stats_fn = jax.jit(global_stats)
+        pulled = jax.device_get(self._stats_fn(self.state))
+        keys = (
+            "n_events", "n_branches", "n_expired",
+            "lane_drops", "node_drops", "match_drops", "seq_collisions",
+        )
+        return {k: int(pulled[k]) for k in keys}
+
+    def runs(self, key: Any) -> int:
+        return int(np.asarray(self.state["runs"])[self.key_index[key]])
+
+    def n_live(self, key: Any) -> int:
+        return int(
+            np.sum(np.asarray(self.state["active"])[self.key_index[key]])
+        )
+
+    def pack(
+        self, events_by_key: Mapping[Any, Seq[Event]]
+    ) -> Dict[str, jnp.ndarray]:
+        """Pack per-key event lists into time-major [T, K] device columns.
+
+        Ragged keys are padded at the tail with valid=False steps; keys
+        absent from the mapping are all-padding for this batch. Work (and
+        global event-id allocation) is O(real events): padding slots are
+        numpy fills carrying gidx -1, never Python-per-slot loops.
+        """
+        lists: List[Seq[Event]] = [() for _ in range(self.K_padded)]
+        T = 0
+        first: Optional[Event] = None
+        for key, evs in events_by_key.items():
+            idx = self.key_index.get(key)
+            if idx is None:
+                raise KeyError(f"unknown key {key!r} (fixed at construction)")
+            lists[idx] = evs
+            T = max(T, len(evs))
+            if first is None and evs:
+                first = evs[0]
+        if T == 0 or first is None:
+            raise ValueError("empty batch")
+        if self._ts_base is None:
+            self._ts_base = int(first.timestamp)
+
+        K = self.K_padded
+        schema = self.query.schema
+        cols: Dict[str, np.ndarray] = {
+            f"f:{name}": np.zeros((T, K), dtype)
+            for name, dtype in schema.fields.items()
+        }
+        cols["ts"] = np.zeros((T, K), np.int32)
+        cols["topic"] = np.zeros((T, K), np.int32)
+        valid = np.zeros((T, K), bool)
+        gidx = np.full((T, K), -1, np.int32)
+
+        for k, evs in enumerate(lists):
+            if not evs:
+                continue
+            n = len(evs)
+            key_cols = schema.pack(
+                [e.value for e in evs],
+                [e.timestamp for e in evs],
+                topics=[e.topic for e in evs],
+                ts_base=self._ts_base,
+            )
+            for name, arr in key_cols.items():
+                cols[name][:n, k] = arr
+            ids = np.arange(self._next_gidx, self._next_gidx + n, dtype=np.int32)
+            gidx[:n, k] = ids
+            self._next_gidx += n
+            for g, e in zip(ids, evs):
+                self._events[int(g)] = e
+            valid[:n, k] = True
+
+        xs = {k: jnp.asarray(v) for k, v in cols.items()}
+        xs["spred"] = eval_stateless_preds(self.query, cols)
+        xs["gidx"] = jnp.asarray(gidx)
+        xs["valid"] = jnp.asarray(valid)
+        if self.mesh is not None:
+            xs = shard_xs(xs, self.mesh)
+        return xs
+
+    def advance(
+        self, events_by_key: Mapping[Any, Seq[Event]]
+    ) -> Dict[Any, List[Sequence]]:
+        """Pack, advance all keys one micro-batch, decode per-key matches."""
+        return self.advance_packed(self.pack(events_by_key))
+
+    def advance_packed(
+        self, xs: Dict[str, jnp.ndarray], decode: bool = True
+    ) -> Dict[Any, List[Sequence]]:
+        """Advance with pre-packed columns (the bench/pipelined ingest path).
+
+        With decode=False the match ring is drained but not materialized into
+        host Sequences; `last_match_counts` holds the per-key totals.
+        """
+        self._processed_gidx = max(
+            self._processed_gidx, int(np.asarray(xs["gidx"]).max())
+        )
+        self.state = self._advance(self.state, xs)
+        counts = np.asarray(self.state["match_count"])
+        out: Dict[Any, List[Sequence]] = {}
+        if decode and counts.sum() > 0:
+            out = self._decode_matches(counts)
+        self.last_match_counts = counts
+        if counts.sum() > 0:
+            self.state = self._drain(self.state)
+        self._batches += 1
+        if self._batches % self.gc_every == 0:
+            self.state = self._gc(self.state)
+            self._prune_events()
+        return out
+
+    # --------------------------------------------------------- checkpointing
+    def snapshot(self) -> bytes:
+        """Serialize the [K]-stacked engine state + key list + registry."""
+        import pickle
+
+        from ..state.serde import (
+            _Writer,
+            MAGIC,
+            encode_array_tree,
+            encode_event_registry,
+        )
+
+        w = _Writer()
+        w._buf.write(MAGIC)
+        w.blob(pickle.dumps(self.keys, protocol=pickle.HIGHEST_PROTOCOL))
+        w.blob(encode_array_tree({k: np.asarray(v) for k, v in self.state.items()}))
+        w.blob(encode_event_registry(self._events))
+        w.i64(self._next_gidx)
+        w.i64(self._ts_base if self._ts_base is not None else -1)
+        w.i64(self._batches)
+        return w.getvalue()
+
+    @classmethod
+    def restore(
+        cls,
+        stages_or_query: Any,
+        data: bytes,
+        schema: Optional[EventSchema] = None,
+        config: Optional[EngineConfig] = None,
+        mesh: Optional[Any] = None,
+        gc_every: int = 1,
+    ) -> "BatchedDeviceNFA":
+        import pickle
+
+        from ..state.serde import (
+            _Reader,
+            MAGIC,
+            decode_array_tree,
+            decode_event_registry,
+        )
+
+        r = _Reader(data)
+        if r._read(4) != MAGIC:
+            raise ValueError("bad checkpoint magic")
+        keys = pickle.loads(r.blob())
+        bat = cls(
+            stages_or_query, keys=keys, schema=schema, config=config,
+            mesh=mesh, gc_every=gc_every,
+        )
+        tree = decode_array_tree(r.blob())
+        state = {k: jnp.asarray(v) for k, v in tree.items()}
+        if mesh is not None:
+            state = shard_state(state, mesh)
+        bat.state = state
+        bat.K_padded = int(tree["active"].shape[0])
+        bat._events = decode_event_registry(r.blob())
+        bat._next_gidx = r.i64()
+        bat._processed_gidx = bat._next_gidx - 1  # no pre-packed xs survive
+        ts_base = r.i64()
+        bat._ts_base = None if ts_base < 0 else ts_base
+        bat._batches = r.i64()
+        return bat
+
+    # ------------------------------------------------------------ internals
+    def _decode_matches(self, counts: np.ndarray) -> Dict[Any, List[Sequence]]:
+        match_node = np.asarray(self.state["match_node"])  # [K, M+1]
+        node_event = np.asarray(self.state["node_event"])  # [K, B+1]
+        node_name = np.asarray(self.state["node_name"])
+        node_pred = np.asarray(self.state["node_pred"])
+        K, Bp1 = node_event.shape
+
+        # Flatten per-key pools into one index space so every chain across
+        # every key walks in the same vectorized pass.
+        key_base = (np.arange(K, dtype=np.int64) * Bp1)[:, None]
+        flat_pred = np.where(node_pred >= 0, node_pred + key_base, -1).reshape(-1)
+        flat_event = node_event.reshape(-1)
+        flat_name = node_name.reshape(-1)
+
+        starts: List[int] = []
+        match_key: List[int] = []
+        for k in range(K):
+            c = int(counts[k])
+            for j in range(c):
+                starts.append(int(match_node[k, j]) + k * Bp1)
+                match_key.append(k)
+        chains = decode_chains(
+            np.asarray(starts, np.int64), flat_name, flat_event, flat_pred
+        )
+        out: Dict[Any, List[Sequence]] = {}
+        for k_idx, chain in zip(match_key, chains):
+            key = self.keys[k_idx]
+            out.setdefault(key, []).append(
+                materialize_sequence(chain, self.query.name_of_id, self._events)
+            )
+        return out
+
+    def _prune_events(self) -> None:
+        """Bound the host event registry: keep pool-referenced events plus
+        anything packed ahead of the processed watermark (pipelined ingest
+        registers events before their batch is advanced)."""
+        if len(self._events) <= self.events_prune_threshold:
+            return
+        live = np.asarray(self.state["node_event"])
+        live_gidx = set(int(g) for g in live[live >= 0])
+        hwm = self._processed_gidx
+        self._events = {
+            g: e for g, e in self._events.items() if g > hwm or g in live_gidx
+        }
+
+
+def _drain_match_ring(state: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Clear the match ring on device (keeps shardings intact under jit)."""
+    return {
+        **state,
+        "match_count": jnp.zeros_like(state["match_count"]),
+        "match_node": jnp.full_like(state["match_node"], -1),
+    }

@@ -1,0 +1,413 @@
+"""Bytes-level checkpoint codecs for the host stores and device state.
+
+Re-design of the reference serde package
+(reference: core/.../cep/state/internal/serde/ComputationStageSerde.java:56-155,
+NFAStateValueSerde.java:79-152, MatchedEventSerde.java:86-118,
+KryoSerDe.java:37-121): engine-owned structure is framed explicitly
+(length-prefixed fields, stages referenced **by id** against the recompiled
+query -- stages themselves are never stored, ComputationStageSerde.java:56-66),
+while user keys/values go through pluggable serdes exactly as the reference
+routes them through Kryo/user serdes. The default serde is pickle (the
+Python analog of the reference's Kryo fallback).
+
+Device state (ops/runtime.py, parallel/batched.py) serializes as raw typed
+array frames (name, dtype, shape, C-order bytes) plus the host-side event
+registry -- restorable into a fresh process with only the pattern + config.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.dewey import DeweyVersion
+from ..core.event import Event
+from ..pattern.stages import Stage, Stages
+from .aggregates import AggregatesStore
+from .buffer import BufferNode, BufferStore, SharedVersionedBuffer
+from .nfa_store import NFAStates, NFAStore
+
+MAGIC = b"KCT1"  # format tag + version
+
+
+def _default_serialize(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _default_deserialize(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+class _Writer:
+    def __init__(self) -> None:
+        self._buf = io.BytesIO()
+
+    def u8(self, v: int) -> None:
+        self._buf.write(struct.pack("<B", v))
+
+    def i32(self, v: int) -> None:
+        self._buf.write(struct.pack("<i", v))
+
+    def i64(self, v: int) -> None:
+        self._buf.write(struct.pack("<q", v))
+
+    def blob(self, data: bytes) -> None:
+        self._buf.write(struct.pack("<I", len(data)))
+        self._buf.write(data)
+
+    def text(self, s: str) -> None:
+        self.blob(s.encode("utf-8"))
+
+    def getvalue(self) -> bytes:
+        return self._buf.getvalue()
+
+
+class _Reader:
+    def __init__(self, data: bytes) -> None:
+        self._buf = io.BytesIO(data)
+
+    def _read(self, n: int) -> bytes:
+        out = self._buf.read(n)
+        if len(out) != n:
+            raise ValueError("truncated checkpoint frame")
+        return out
+
+    def u8(self) -> int:
+        return struct.unpack("<B", self._read(1))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self._read(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self._read(8))[0]
+
+    def blob(self) -> bytes:
+        (n,) = struct.unpack("<I", self._read(4))
+        return self._read(n)
+
+    def text(self) -> str:
+        return self.blob().decode("utf-8")
+
+
+class CheckpointCodec:
+    """Codec bound to one compiled query (stages re-linked by index).
+
+    The stage table must be the same compile output shape on encode and
+    decode -- the reference makes the same assumption when it rebuilds
+    stages from ids against the recompiled pattern
+    (ComputationStageSerde.java:90-101).
+    """
+
+    def __init__(
+        self,
+        stages: Stages,
+        serialize: Callable[[Any], bytes] = _default_serialize,
+        deserialize: Callable[[bytes], Any] = _default_deserialize,
+        strict_windows: bool = False,
+    ) -> None:
+        self.stages = stages
+        self._stage_list: List[Stage] = list(stages)
+        self._index_of: Dict[int, int] = {
+            id(s): i for i, s in enumerate(self._stage_list)
+        }
+        self._ser = serialize
+        self._de = deserialize
+        self.strict_windows = strict_windows
+
+    # ---------------------------------------------------------------- events
+    def _put_event(self, w: _Writer, event: Optional[Event]) -> None:
+        if event is None:
+            w.u8(0)
+            return
+        w.u8(1)
+        w.blob(self._ser(event.key))
+        w.blob(self._ser(event.value))
+        w.i64(event.timestamp)
+        w.text(event.topic)
+        w.i32(event.partition)
+        w.i64(event.offset)
+
+    def _get_event(self, r: _Reader) -> Optional[Event]:
+        if r.u8() == 0:
+            return None
+        key = self._de(r.blob())
+        value = self._de(r.blob())
+        ts = r.i64()
+        topic = r.text()
+        partition = r.i32()
+        offset = r.i64()
+        return Event(key, value, ts, topic, partition, offset)
+
+    # ---------------------------------------------------------------- stages
+    def _stage_ref(self, stage: Stage) -> Tuple[int, int]:
+        """(compiled index, epsilon-target index | -1) for a runtime stage."""
+        idx = self._index_of.get(id(stage))
+        if idx is not None:
+            return idx, -1
+        # Synthesized epsilon: identity is a compiled stage (same id/name),
+        # target is its single PROCEED edge.
+        target = stage.edges[0].target
+        tgt_idx = self._index_of.get(id(target))
+        src_idx = next(
+            (
+                i
+                for i, s in enumerate(self._stage_list)
+                if s.id == stage.id and s.name == stage.name and s.type == stage.type
+            ),
+            None,
+        )
+        if src_idx is None or tgt_idx is None:
+            raise ValueError(f"stage {stage!r} does not belong to this query")
+        return src_idx, tgt_idx
+
+    def _resolve_stage(self, idx: int, eps_target: int) -> Stage:
+        stage = self._stage_list[idx]
+        if eps_target < 0:
+            return stage
+        target = self._stage_list[eps_target]
+        eps = Stage.new_epsilon(stage, target)
+        if self.strict_windows:
+            eps.window_ms = (
+                target.window_ms if target.window_ms != -1 else stage.window_ms
+            )
+        return eps
+
+    # ------------------------------------------------------------- NFAStates
+    def encode_nfa_states(self, snap: NFAStates) -> bytes:
+        """Frame: run queue (stage ids + versions + embedded last events),
+        runs counter, offset high-water marks
+        (NFAStateValueSerde.java:79-116)."""
+        w = _Writer()
+        w._buf.write(MAGIC)
+        w.i32(len(snap.computation_stages))
+        for cs in snap.computation_stages:
+            src, eps = self._stage_ref(cs.stage)
+            w.i32(src)
+            w.i32(eps)
+            w.i32(len(cs.version.digits))
+            for d in cs.version.digits:
+                w.i32(d)
+            w.i64(cs.sequence)
+            w.i64(cs.timestamp)
+            w.u8(1 if cs.is_branching else 0)
+            w.u8(1 if cs.is_ignored else 0)
+            w.i64(cs.last_node if cs.last_node is not None else -1)
+            self._put_event(w, cs.last_event)
+        w.i64(snap.runs)
+        w.i32(len(snap.latest_offsets))
+        for topic, offset in snap.latest_offsets.items():
+            w.text(topic)
+            w.i64(offset)
+        return w.getvalue()
+
+    def decode_nfa_states(self, data: bytes) -> NFAStates:
+        from ..nfa.nfa import ComputationStage
+
+        r = _Reader(data)
+        if r._read(4) != MAGIC:
+            raise ValueError("bad checkpoint magic")
+        n = r.i32()
+        queue = []
+        for _ in range(n):
+            src = r.i32()
+            eps = r.i32()
+            digits = tuple(r.i32() for _ in range(r.i32()))
+            sequence = r.i64()
+            timestamp = r.i64()
+            is_branching = bool(r.u8())
+            is_ignored = bool(r.u8())
+            last_node = r.i64()
+            last_event = self._get_event(r)
+            queue.append(
+                ComputationStage(
+                    stage=self._resolve_stage(src, eps),
+                    version=DeweyVersion(digits),
+                    sequence=sequence,
+                    last_event=last_event,
+                    timestamp=timestamp,
+                    is_branching=is_branching,
+                    is_ignored=is_ignored,
+                    last_node=None if last_node < 0 else last_node,
+                )
+            )
+        runs = r.i64()
+        offsets = {}
+        for _ in range(r.i32()):
+            topic = r.text()
+            offsets[topic] = r.i64()
+        return NFAStates(queue, runs, offsets)
+
+    # ---------------------------------------------------------------- buffer
+    def encode_buffer(self, buffer: SharedVersionedBuffer) -> bytes:
+        """Node frame: id, stage name, embedded event, parent id
+        (MatchedEventSerde.java:86-118 analog, minus refcounts -- reclamation
+        is mark-sweep here)."""
+        w = _Writer()
+        w._buf.write(MAGIC)
+        w.i64(buffer._next_id)
+        w.i32(len(buffer._nodes))
+        for node_id, node in buffer._nodes.items():
+            w.i64(node_id)
+            w.text(node.stage_name)
+            self._put_event(w, node.event)
+            w.i64(node.parent if node.parent is not None else -1)
+        return w.getvalue()
+
+    def decode_buffer(self, data: bytes) -> SharedVersionedBuffer:
+        r = _Reader(data)
+        if r._read(4) != MAGIC:
+            raise ValueError("bad checkpoint magic")
+        buffer: SharedVersionedBuffer = SharedVersionedBuffer()
+        buffer._next_id = r.i64()
+        n = r.i32()
+        for _ in range(n):
+            node_id = r.i64()
+            stage_name = r.text()
+            event = self._get_event(r)
+            parent = r.i64()
+            buffer._nodes[node_id] = BufferNode(
+                stage_name, event, None if parent < 0 else parent
+            )
+        return buffer
+
+    # ------------------------------------------------------------ aggregates
+    def encode_aggregates(self, store: AggregatesStore) -> bytes:
+        """(record key, name, run id) -> value frames
+        (AggregateKeySerde.java:107-121 analog)."""
+        w = _Writer()
+        w._buf.write(MAGIC)
+        w.i32(len(store._store))
+        for (key, name, sequence), value in store._store.items():
+            w.blob(self._ser(key))
+            w.text(name)
+            w.i64(sequence)
+            w.blob(self._ser(value))
+        return w.getvalue()
+
+    def decode_aggregates(self, data: bytes) -> AggregatesStore:
+        r = _Reader(data)
+        if r._read(4) != MAGIC:
+            raise ValueError("bad checkpoint magic")
+        store = AggregatesStore()
+        for _ in range(r.i32()):
+            key = self._de(r.blob())
+            name = r.text()
+            sequence = r.i64()
+            value = self._de(r.blob())
+            store._store[(key, name, sequence)] = value
+        return store
+
+    # ---------------------------------------------------- query-level stores
+    def encode_query_stores(
+        self,
+        nfa_store: NFAStore,
+        buffers: BufferStore,
+        aggregates: AggregatesStore,
+    ) -> bytes:
+        """One checkpoint blob for a query's three stores -- the changelog
+        record equivalent (README.md:350-355 store naming scheme)."""
+        w = _Writer()
+        w._buf.write(MAGIC)
+        w.i32(len(nfa_store._store))
+        for key, snap in nfa_store._store.items():
+            w.blob(self._ser(key))
+            w.blob(self.encode_nfa_states(snap))
+        w.i32(len(buffers._buffers))
+        for key, buffer in buffers._buffers.items():
+            w.blob(self._ser(key))
+            w.blob(self.encode_buffer(buffer))
+        w.blob(self.encode_aggregates(aggregates))
+        return w.getvalue()
+
+    def decode_query_stores(
+        self, data: bytes
+    ) -> Tuple[NFAStore, BufferStore, AggregatesStore]:
+        r = _Reader(data)
+        if r._read(4) != MAGIC:
+            raise ValueError("bad checkpoint magic")
+        nfa_store = NFAStore()
+        for _ in range(r.i32()):
+            key = self._de(r.blob())
+            nfa_store._store[key] = self.decode_nfa_states(r.blob())
+        buffers = BufferStore()
+        for _ in range(r.i32()):
+            key = self._de(r.blob())
+            buffers._buffers[key] = self.decode_buffer(r.blob())
+        aggregates = self.decode_aggregates(r.blob())
+        return nfa_store, buffers, aggregates
+
+
+# ---------------------------------------------------------------------------
+# Device state frames
+# ---------------------------------------------------------------------------
+def encode_array_tree(
+    tree: Dict[str, Any],
+    serialize: Callable[[Any], bytes] = _default_serialize,
+) -> bytes:
+    """Raw typed frames for a flat dict of arrays (the device state dict)."""
+    w = _Writer()
+    w._buf.write(MAGIC)
+    w.i32(len(tree))
+    for name in sorted(tree):
+        arr = np.asarray(tree[name])
+        w.text(name)
+        w.text(str(arr.dtype))
+        w.i32(arr.ndim)
+        for dim in arr.shape:
+            w.i64(dim)
+        w.blob(arr.tobytes(order="C"))
+    return w.getvalue()
+
+
+def decode_array_tree(data: bytes) -> Dict[str, np.ndarray]:
+    r = _Reader(data)
+    if r._read(4) != MAGIC:
+        raise ValueError("bad checkpoint magic")
+    out: Dict[str, np.ndarray] = {}
+    for _ in range(r.i32()):
+        name = r.text()
+        dtype = np.dtype(r.text())
+        shape = tuple(r.i64() for _ in range(r.i32()))
+        raw = r.blob()
+        out[name] = np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+    return out
+
+
+def encode_event_registry(
+    events: Dict[int, Event],
+    serialize: Callable[[Any], bytes] = _default_serialize,
+) -> bytes:
+    codec = _EventOnly(serialize, _default_deserialize)
+    w = _Writer()
+    w._buf.write(MAGIC)
+    w.i32(len(events))
+    for gidx, event in events.items():
+        w.i64(gidx)
+        codec._put_event(w, event)
+    return w.getvalue()
+
+
+def decode_event_registry(
+    data: bytes,
+    deserialize: Callable[[bytes], Any] = _default_deserialize,
+) -> Dict[int, Event]:
+    codec = _EventOnly(_default_serialize, deserialize)
+    r = _Reader(data)
+    if r._read(4) != MAGIC:
+        raise ValueError("bad checkpoint magic")
+    out: Dict[int, Event] = {}
+    for _ in range(r.i32()):
+        gidx = r.i64()
+        out[gidx] = codec._get_event(r)
+    return out
+
+
+class _EventOnly(CheckpointCodec):
+    """Event framing without a stage table (device registries)."""
+
+    def __init__(self, serialize, deserialize) -> None:
+        self._ser = serialize
+        self._de = deserialize

@@ -44,12 +44,40 @@ class Record:
 
 
 class QueryNode(Generic[K, V]):
-    """One registered query: processor + stores + downstream sinks."""
+    """One registered query: processor + stores + downstream sinks.
 
-    def __init__(self, name: str, pattern: Pattern, queried: Optional[Queried]) -> None:
+    runtime="host": the per-record oracle driver (streams/processor.py).
+    runtime="tpu": the micro-batching batched device driver
+    (streams/device_processor.py); matches surface when a batch fills or on
+    `Topology.flush()`.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        pattern: Pattern,
+        queried: Optional[Queried],
+        runtime: str = "host",
+        **device_opts: Any,
+    ) -> None:
         self.name = normalize_query_name(name)
         self.pattern = pattern
         self.queried = queried
+        self.runtime = runtime
+        self.downstream: List[Callable] = []
+        if runtime == "tpu":
+            from .device_processor import DeviceCEPProcessor
+
+            self.stores = {}
+            self.processor: Any = DeviceCEPProcessor(
+                name,
+                pattern,
+                schema=queried.schema if queried is not None else None,
+                **device_opts,
+            )
+            return
+        if runtime != "host":
+            raise ValueError(f"unknown runtime {runtime!r} (host|tpu)")
         self.stores: Dict[str, Any] = {
             nfa_states_store(name): NFAStore(),
             event_buffer_store(name): BufferStore(),
@@ -62,7 +90,6 @@ class QueryNode(Generic[K, V]):
             buffer=self.stores[event_buffer_store(name)],
             aggregates=self.stores[aggregates_store(name)],
         )
-        self.downstream: List[Callable] = []
 
 
 class CEPStream(Generic[K, V]):
@@ -77,8 +104,10 @@ class CEPStream(Generic[K, V]):
         name: str,
         pattern: Pattern,
         queried: Optional[Queried] = None,
+        runtime: str = "host",
+        **device_opts: Any,
     ) -> "OutputStream":
-        node = QueryNode(name, pattern, queried)
+        node = QueryNode(name, pattern, queried, runtime=runtime, **device_opts)
         out = OutputStream(node)
         self._builder._register(self, node, out)
         return out
@@ -137,13 +166,52 @@ class Topology:
         for stream, node, out in self.queries:
             if topic not in stream.topics:
                 continue
-            sequences = node.processor.process(
+            results = node.processor.process(
                 key, value, timestamp=timestamp, topic=topic, partition=partition, offset=offset
             )
-            for seq in sequences:
-                record = Record(key, seq, timestamp, topic, partition, offset)
-                out.records.append(record)
-                outputs.append(record)
-                for fn in node.downstream:
-                    fn(key, seq)
+            if node.runtime == "tpu":
+                # Device results span every key in the flushed micro-batch;
+                # record metadata derives from each match's last event.
+                outputs.extend(self._emit_device(node, out, results))
+            else:
+                for seq in results:
+                    record = Record(key, seq, timestamp, topic, partition, offset)
+                    out.records.append(record)
+                    outputs.append(record)
+                    for fn in node.downstream:
+                        fn(key, seq)
         return outputs
+
+    def flush(self) -> List[Record]:
+        """Flush pending device micro-batches (no-op for host queries)."""
+        outputs: List[Record] = []
+        for _stream, node, out in self.queries:
+            flush = getattr(node.processor, "flush", None)
+            if flush is None:
+                continue
+            outputs.extend(self._emit_device(node, out, flush()))
+        return outputs
+
+    def _emit_device(
+        self, node, out: "OutputStream", results, timestamp: Optional[int] = None
+    ) -> List[Record]:
+        """Route device-processor [(key, Sequence)] results downstream.
+
+        Record metadata comes from the match's completing (last) event so
+        host- and device-runtime outputs carry equivalent context."""
+        emitted: List[Record] = []
+        for rkey, seq in results:
+            last = seq.matched[-1].events[-1] if seq.matched else None
+            record = Record(
+                rkey,
+                seq,
+                timestamp if timestamp is not None else (last.timestamp if last else 0),
+                last.topic if last else "",
+                last.partition if last else 0,
+                last.offset if last else 0,
+            )
+            out.records.append(record)
+            emitted.append(record)
+            for fn in node.downstream:
+                fn(rkey, seq)
+        return emitted

@@ -1,0 +1,211 @@
+"""Micro-batching device processor: the `runtime="tpu"` stream driver.
+
+The device counterpart of streams/processor.py, keeping the reference
+processor's contract -- per-key NFA state, high-water-mark idempotence,
+forward completed Sequences
+(reference: core/.../cep/processor/CEPProcessor.java:111-160) -- while
+replacing the per-record `nfa.match_pattern` call with the multi-key batched
+engine (parallel/batched.py): records accumulate per key in a pending
+buffer, and each flush packs one [T, K] column batch, advances every key's
+NFA in a single device program, and decodes the completed matches.
+
+Key lanes are assigned on first sight and grown geometrically (a growth
+re-specializes the jitted step for the new key extent, so doubling bounds
+recompiles to O(log keys)).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Generic, List, Optional, Tuple, TypeVar
+
+from ..core.event import Event
+from ..core.sequence import Sequence
+from ..ops.engine import EngineConfig
+from ..ops.schema import EventSchema
+from ..ops.tables import CompiledQuery, compile_query
+from ..parallel.batched import BatchedDeviceNFA
+from ..pattern.compiler import compile_pattern
+from ..pattern.pattern import Pattern
+from ..state.naming import normalize_query_name
+
+K = TypeVar("K")
+V = TypeVar("V")
+
+
+class DeviceCEPProcessor(Generic[K, V]):
+    """Batched device driver bound to one compiled query.
+
+    `process()` enqueues and auto-flushes once `batch_size` records are
+    pending; `flush()` forces the pending micro-batch through the engine and
+    returns [(key, Sequence)] in per-key emission order.
+    """
+
+    def __init__(
+        self,
+        query_name: str,
+        pattern_or_query: Any,
+        schema: Optional[EventSchema] = None,
+        config: Optional[EngineConfig] = None,
+        batch_size: int = 64,
+        initial_keys: int = 8,
+        mesh: Optional[Any] = None,
+        gc_every: int = 1,
+    ) -> None:
+        if isinstance(pattern_or_query, CompiledQuery):
+            self.query = pattern_or_query
+        elif isinstance(pattern_or_query, Pattern):
+            self.query = compile_query(compile_pattern(pattern_or_query), schema)
+        else:
+            self.query = compile_query(pattern_or_query, schema)
+        self.query_name = normalize_query_name(query_name)
+        self.config = config if config is not None else EngineConfig()
+        self.batch_size = max(1, batch_size)
+        self._capacity = max(1, initial_keys)
+        self.engine = BatchedDeviceNFA(
+            self.query,
+            keys=[_Lane(i) for i in range(self._capacity)],
+            config=self.config,
+            mesh=mesh,
+            gc_every=gc_every,
+        )
+        self._lane_of_key: Dict[Any, _Lane] = {}
+        self._next_lane = 0
+        self._pending: Dict[Any, List[Event]] = {}
+        self._pending_count = 0
+        # Per-(key, topic#partition) high-water mark (CEPProcessor.java:152-160;
+        # per-partition for the same reason as streams/processor.py).
+        self._hwm: Dict[Tuple[Any, str], int] = {}
+
+    # ------------------------------------------------------------------ API
+    def process(
+        self,
+        key: K,
+        value: V,
+        timestamp: int = 0,
+        topic: str = "",
+        partition: int = 0,
+        offset: int = 0,
+    ) -> List[Tuple[K, Sequence[K, V]]]:
+        """Enqueue one record; returns flushed matches when the batch fills."""
+        if key is None or value is None:
+            return []
+        hwm_key = (key, f"{topic}#{partition}")
+        latest = self._hwm.get(hwm_key)
+        if latest is not None and offset < latest:
+            return []  # replayed record below the high-water mark
+        self._hwm[hwm_key] = offset + 1
+
+        self._pending.setdefault(key, []).append(
+            Event(key, value, timestamp, topic, partition, offset)
+        )
+        self._pending_count += 1
+        if self._pending_count >= self.batch_size:
+            return self.flush()
+        return []
+
+    def flush(self) -> List[Tuple[K, Sequence[K, V]]]:
+        """Drive the pending micro-batch through the device engine."""
+        if not self._pending:
+            return []
+        batch: Dict[_Lane, List[Event]] = {}
+        for key, events in self._pending.items():
+            batch[self._lane_for(key)] = events
+        self._pending = {}
+        self._pending_count = 0
+
+        out: List[Tuple[K, Sequence]] = []
+        for lane, seqs in self.engine.advance(batch).items():
+            out.extend((lane.key, s) for s in seqs)
+        return out
+
+    def runs(self, key: K) -> int:
+        return self.engine.runs(self._lane_for(key))
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return self.engine.stats
+
+    # --------------------------------------------------------- checkpointing
+    def snapshot(self) -> bytes:
+        """Bytes-level checkpoint: engine state + lane map + HWM + pending."""
+        import pickle
+
+        from ..state.serde import _Writer, MAGIC, encode_event_registry
+
+        w = _Writer()
+        w._buf.write(MAGIC)
+        w.blob(self.engine.snapshot())
+        w.blob(pickle.dumps(self._hwm, protocol=pickle.HIGHEST_PROTOCOL))
+        w.i32(len(self._pending))
+        for key, events in self._pending.items():
+            w.blob(pickle.dumps(key, protocol=pickle.HIGHEST_PROTOCOL))
+            w.blob(encode_event_registry(dict(enumerate(events))))
+        return w.getvalue()
+
+    @classmethod
+    def restore(
+        cls,
+        query_name: str,
+        pattern_or_query: Any,
+        data: bytes,
+        schema: Optional[EventSchema] = None,
+        config: Optional[EngineConfig] = None,
+        batch_size: int = 64,
+        mesh: Optional[Any] = None,
+        gc_every: int = 1,
+    ) -> "DeviceCEPProcessor":
+        import pickle
+
+        from ..state.serde import _Reader, MAGIC, decode_event_registry
+
+        proc = cls(
+            query_name, pattern_or_query, schema=schema, config=config,
+            batch_size=batch_size, mesh=mesh, gc_every=gc_every,
+        )
+        r = _Reader(data)
+        if r._read(4) != MAGIC:
+            raise ValueError("bad checkpoint magic")
+        proc.engine = BatchedDeviceNFA.restore(
+            proc.query, r.blob(), config=proc.config, mesh=mesh, gc_every=gc_every
+        )
+        proc._capacity = len(proc.engine.keys)
+        proc._lane_of_key = {
+            lane.key: lane for lane in proc.engine.keys if lane.key is not None
+        }
+        proc._next_lane = len(proc._lane_of_key)
+        proc._hwm = pickle.loads(r.blob())
+        proc._pending = {}
+        proc._pending_count = 0
+        for _ in range(r.i32()):
+            key = pickle.loads(r.blob())
+            events = decode_event_registry(r.blob())
+            proc._pending[key] = [events[i] for i in sorted(events)]
+            proc._pending_count += len(events)
+        return proc
+
+    # ------------------------------------------------------------ internals
+    def _lane_for(self, key: Any) -> "_Lane":
+        lane = self._lane_of_key.get(key)
+        if lane is not None:
+            return lane
+        if self._next_lane >= self._capacity:
+            grow = self._capacity  # double
+            self.engine.add_keys([_Lane(self._capacity + i) for i in range(grow)])
+            self._capacity += grow
+        lane = self.engine.keys[self._next_lane]
+        lane.key = key
+        self._next_lane += 1
+        self._lane_of_key[key] = lane
+        return lane
+
+
+class _Lane:
+    """A stable lane handle; `key` binds on first assignment."""
+
+    __slots__ = ("index", "key")
+
+    def __init__(self, index: int) -> None:
+        self.index = index
+        self.key: Any = None
+
+    def __repr__(self) -> str:
+        return f"Lane({self.index}:{self.key!r})"

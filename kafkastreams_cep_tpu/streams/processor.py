@@ -40,6 +40,7 @@ class CEPProcessor(Generic[K, V]):
         nfa_store: Optional[NFAStore] = None,
         buffer: Optional[BufferStore] = None,
         aggregates: Optional[AggregatesStore] = None,
+        strict_windows: bool = False,
     ) -> None:
         if isinstance(pattern_or_stages, Pattern):
             self.stages: Stages = compile_pattern(pattern_or_stages)
@@ -49,6 +50,9 @@ class CEPProcessor(Generic[K, V]):
         self.nfa_store = nfa_store if nfa_store is not None else NFAStore()
         self.buffer = buffer if buffer is not None else BufferStore()
         self.aggregates = aggregates if aggregates is not None else AggregatesStore()
+        # See NFA(strict_windows=...): False = reference window parity,
+        # True = epsilon stages inherit windows (bounded-memory mode).
+        self.strict_windows = strict_windows
 
     def _load_nfa(self, key: K) -> Tuple[NFA, NFAStates]:
         snapshot = self.nfa_store.find(key)
@@ -60,9 +64,13 @@ class CEPProcessor(Generic[K, V]):
                 self.stages.defined_states(),
                 snapshot.computation_stages,
                 snapshot.runs,
+                strict_windows=self.strict_windows,
             )
             return nfa, snapshot
-        nfa = NFA.build(self.stages, self.aggregates, key_buffer)
+        nfa = NFA.build(
+            self.stages, self.aggregates, key_buffer,
+            strict_windows=self.strict_windows,
+        )
         return nfa, NFAStates(list(nfa.computation_stages), nfa.runs)
 
     def process(
@@ -97,3 +105,35 @@ class CEPProcessor(Generic[K, V]):
             key, NFAStates(list(nfa.computation_stages), nfa.runs, offsets)
         )
         return sequences
+
+    # --------------------------------------------------------- checkpointing
+    def snapshot(self) -> bytes:
+        """Bytes-level checkpoint of the query's three stores (the changelog
+        write, reference: CEPProcessor.java:144-147 + store serdes)."""
+        from ..state.serde import CheckpointCodec
+
+        codec = CheckpointCodec(self.stages, strict_windows=self.strict_windows)
+        return codec.encode_query_stores(
+            self.nfa_store, self.buffer, self.aggregates
+        )
+
+    @classmethod
+    def restore(
+        cls,
+        query_name: str,
+        pattern_or_stages: Any,
+        data: bytes,
+        strict_windows: bool = False,
+    ) -> "CEPProcessor":
+        """Rebuild a processor from `snapshot()` bytes in a fresh object
+        graph: the pattern is recompiled and run-queue stages re-linked by
+        id (ComputationStageSerde.java:56-101)."""
+        from ..state.serde import CheckpointCodec
+
+        proc = cls(query_name, pattern_or_stages, strict_windows=strict_windows)
+        codec = CheckpointCodec(proc.stages, strict_windows=strict_windows)
+        nfa_store, buffers, aggregates = codec.decode_query_stores(data)
+        proc.nfa_store = nfa_store
+        proc.buffer = buffers
+        proc.aggregates = aggregates
+        return proc

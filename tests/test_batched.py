@@ -1,0 +1,155 @@
+"""Multi-key batched driver conformance: K lanes == K independent engines.
+
+The parallelism contract (SURVEY.md section 2.8): the batched [T, K] engine
+must be observationally identical to K independent single-key DeviceNFAs --
+per-key matches, run counters and live-queue sizes -- including ragged
+batches, absent keys, and a key axis sharded over the 8-device CPU mesh
+(reference behavior: one NFA per record key, CEPProcessor.java:111-124,139).
+"""
+import random
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding
+
+from kafkastreams_cep_tpu import Event, QueryBuilder, Selected, compile_pattern
+from kafkastreams_cep_tpu.ops.engine import EngineConfig
+from kafkastreams_cep_tpu.ops.runtime import DeviceNFA
+from kafkastreams_cep_tpu.parallel import (
+    KEY_AXIS,
+    BatchedDeviceNFA,
+    global_stats,
+    key_mesh,
+)
+from kafkastreams_cep_tpu.pattern.expressions import agg, value
+
+CONFIG = EngineConfig(lanes=64, nodes=512, matches=128)
+TS = 1_000_000
+
+
+def branching_pattern():
+    """skip-till-any + one_or_more: exercises branching, folds, windows."""
+    return (
+        QueryBuilder()
+        .select("first")
+        .where(value() == "A")
+        .fold("cnt", agg("cnt", default=0) + 1)
+        .then()
+        .select("second", Selected.with_skip_til_any_match())
+        .one_or_more()
+        .where(value() == "C")
+        .then()
+        .select("latest")
+        .where(value() == "D")
+        .build()
+    )
+
+
+def letter_stream(seed, n):
+    rng = random.Random(seed)
+    return [
+        Event(f"k{seed}-e{i}", rng.choice("ABCD"), TS + i, "t", 0, i)
+        for i in range(n)
+    ]
+
+
+def drive_independent(pattern, streams, batches):
+    """Oracle: one DeviceNFA per key, same batch splits."""
+    out = {}
+    runs, live = {}, {}
+    for key, events in streams.items():
+        dev = DeviceNFA(compile_pattern(pattern), config=CONFIG)
+        got = []
+        for lo, hi in batches:
+            chunk = events[lo:hi]
+            if chunk:
+                got.extend(dev.advance(chunk))
+        out[key] = got
+        runs[key] = dev.runs
+        live[key] = dev.n_live
+    return out, runs, live
+
+
+def drive_batched(pattern, streams, batches, mesh=None):
+    keys = list(streams)
+    bat = BatchedDeviceNFA(
+        compile_pattern(pattern), keys=keys, config=CONFIG, mesh=mesh
+    )
+    got = {k: [] for k in keys}
+    for lo, hi in batches:
+        chunk = {
+            k: evs[lo:hi] for k, evs in streams.items() if evs[lo:hi]
+        }
+        if not chunk:
+            continue
+        for k, seqs in bat.advance(chunk).items():
+            got[k].extend(seqs)
+    return bat, got
+
+
+@pytest.mark.parametrize("split", [[(0, 100)], [(0, 5), (5, 9), (9, 100)]])
+def test_batched_equals_independent(split):
+    pattern = branching_pattern()
+    # Ragged per-key lengths: key2 is exhausted before the last batch.
+    streams = {
+        "k0": letter_stream(0, 16),
+        "k1": letter_stream(1, 12),
+        "k2": letter_stream(2, 7),
+        "k3": letter_stream(3, 16),
+    }
+    expected, e_runs, e_live = drive_independent(pattern, streams, split)
+    bat, got = drive_batched(pattern, streams, split)
+
+    assert bat.stats["lane_drops"] == 0 and bat.stats["node_drops"] == 0
+    for k in streams:
+        assert got[k] == expected[k], f"key {k} diverges"
+        assert bat.runs(k) == e_runs[k]
+        assert bat.n_live(k) == e_live[k]
+
+
+def test_batched_absent_key_untouched():
+    pattern = branching_pattern()
+    streams = {"a": letter_stream(7, 8), "b": letter_stream(8, 8)}
+    bat = BatchedDeviceNFA(compile_pattern(pattern), keys=["a", "b"], config=CONFIG)
+    bat.advance({"a": streams["a"][:4], "b": streams["b"][:4]})
+    runs_b = bat.runs("b")
+    live_b = bat.n_live("b")
+    bat.advance({"a": streams["a"][4:]})  # b absent: all-padding lanes
+    assert bat.runs("b") == runs_b
+    assert bat.n_live("b") == live_b
+
+    # And b still finishes identically to an independent engine.
+    bat.advance({"b": streams["b"][4:]})
+    dev = DeviceNFA(compile_pattern(pattern), config=CONFIG)
+    dev.advance(streams["b"])
+    assert bat.runs("b") == dev.runs
+    assert bat.n_live("b") == dev.n_live
+
+
+def test_batched_sharded_over_mesh():
+    """Key axis sharded over the 8 virtual CPU devices == unsharded run."""
+    assert len(jax.devices()) == 8, "conftest must force an 8-device CPU mesh"
+    mesh = key_mesh()
+    pattern = branching_pattern()
+    streams = {f"k{i}": letter_stream(100 + i, 10) for i in range(16)}
+    batches = [(0, 6), (6, 100)]
+
+    _, want = drive_batched(pattern, streams, batches, mesh=None)
+    bat, got = drive_batched(pattern, streams, batches, mesh=mesh)
+
+    # State really is sharded along the key axis.
+    sh = bat.state["active"].sharding
+    assert isinstance(sh, NamedSharding)
+    assert sh.spec and sh.spec[0] == KEY_AXIS
+    assert got == want
+
+
+def test_global_stats_reduction():
+    pattern = branching_pattern()
+    streams = {f"k{i}": letter_stream(200 + i, 8) for i in range(8)}
+    bat, _ = drive_batched(pattern, streams, [(0, 100)], mesh=key_mesh())
+    g = global_stats(bat.state)
+    assert int(g["n_events"]) == sum(len(s) for s in streams.values())
+    assert int(g["runs"]) == sum(bat.runs(k) for k in streams)

@@ -97,11 +97,15 @@ def random_pattern(rng: random.Random):
 
 
 def random_stream(rng: random.Random, n: int):
+    # One constant record key: the engines model a single per-key NFA, and
+    # the host aggregates store addresses registers by record key
+    # (AggregatesStoreImpl.java:55-75) -- distinct keys would silently decouple
+    # every fold read from its writes and mask stateful-predicate divergences.
     events = []
     ts = 1000
     for i in range(n):
         ts += rng.choice([0, 1, 1, 2, 7])
-        events.append(Event(f"e{i}", rng.choice(ALPHABET), ts, "t", 0, i))
+        events.append(Event("K", rng.choice(ALPHABET), ts, "t", 0, i))
     return events
 
 
@@ -120,6 +124,93 @@ def test_differential(seed):
     dev = DeviceNFA(compile_pattern(pattern), config=CONFIG)
     split = len(events) // 2
     got = dev.advance(events[:split]) + dev.advance(events[split:])
+
+    assert dev.stats["lane_drops"] == 0 and dev.stats["node_drops"] == 0
+    assert got == expected
+    assert dev.runs == oracle.runs
+    assert dev.n_live == len(oracle.computation_stages)
+
+
+# ---------------------------------------------------------------------------
+# Extended harness: longer streams, gating stateful predicates, windows
+# enforced in strict mode (bounded run populations), batch splits down to
+# single-event boundaries.
+# ---------------------------------------------------------------------------
+def random_pattern_extended(rng: random.Random):
+    """Like random_pattern, but every query carries a small within() window
+    and the stateful conjuncts actually gate (agg <= bound), so fold-register
+    parity is observable in the match sets."""
+    n_stages = rng.randint(3, 4)
+    qb = QueryBuilder()
+    builder = None
+    for i in range(n_stages):
+        last = i == n_stages - 1
+        strategy = (
+            None
+            if i == 0
+            else rng.choice(
+                [None, Selected.with_skip_til_next_match(), Selected.with_skip_til_any_match()]
+            )
+        )
+        name = f"s{i}"
+        sel = qb.select(name) if strategy is None else qb.select(name, strategy)
+        if builder is not None:
+            sel = (
+                builder.then().select(name)
+                if strategy is None
+                else builder.then().select(name, strategy)
+            )
+        if not last and i > 0:
+            card = rng.randint(0, 4)
+            if card == 1:
+                sel = sel.one_or_more()
+            elif card == 2:
+                sel = sel.zero_or_more()
+            elif card == 3:
+                sel = sel.times(2)
+            elif card == 4:
+                sel = sel.optional()
+        letter = rng.choice(ALPHABET[: 2 + i])
+        pred = value() == letter
+        if i > 0 and rng.random() < 0.5:
+            # A gating stateful conjunct: only fires while the counter fold
+            # is below a small bound -- register divergence changes matches.
+            pred = pred & (agg("cnt0", default=0) <= rng.randint(1, 3))
+        builder = sel.where(pred)
+        if i == 0 or rng.random() < 0.5:
+            builder = builder.fold(f"cnt{i}" if i else "cnt0", agg("cnt0" if not i else f"cnt{i}", default=0) + 1)
+    return builder.within(ms=rng.choice([4, 8, 16, 24])).build()
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_differential_extended(seed):
+    rng = random.Random(777_000 + seed)
+    pattern = random_pattern_extended(rng)
+    events = random_stream(rng, 64)
+
+    stages = compile_pattern(pattern)
+    oracle = NFA.build(
+        stages, AggregatesStore(), SharedVersionedBuffer(), strict_windows=True
+    )
+    expected = []
+    for e in events:
+        expected.extend(oracle.match_pattern(e))
+
+    from kafkastreams_cep_tpu.ops.engine import EngineConfig as _EC
+
+    dev = DeviceNFA(
+        compile_pattern(pattern),
+        config=_EC(lanes=512, nodes=4096, matches=512, strict_windows=True),
+        gc_every=rng.choice([1, 2, 4]),
+    )
+    got = []
+    # Random batch splits, including single-event boundaries: batch edges
+    # must be unobservable in the output.
+    i = 0
+    while i < len(events):
+        step = 1 if seed % 4 == 0 else rng.randint(1, 9)
+        got.extend(dev.advance(events[i : i + step]))
+        i += step
 
     assert dev.stats["lane_drops"] == 0 and dev.stats["node_drops"] == 0
     assert got == expected

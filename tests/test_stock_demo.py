@@ -32,6 +32,81 @@ def test_stock_demo_golden(pattern_fn):
     assert all(r.key == "K1" for r in out.records)
 
 
+def _stock_queried():
+    import numpy as np
+
+    from kafkastreams_cep_tpu.ops.schema import EventSchema
+    from kafkastreams_cep_tpu.streams.serde import Queried
+
+    return Queried(
+        schema=EventSchema(
+            {"name": np.int32, "price": np.int32, "volume": np.int32}
+        )
+    )
+
+
+def test_stock_demo_golden_device_runtime():
+    """The golden demo end-to-end through runtime="tpu": DSL -> topology ->
+    micro-batching device processor -> JSON egress (VERDICT r2 item 4)."""
+    builder = ComplexStreamsBuilder()
+    stream = builder.stream("stock-events")
+    out = stream.query(
+        "Stocks", stocks_pattern(), _stock_queried(), runtime="tpu", batch_size=3
+    )
+    topology = builder.build()
+
+    for i, event in enumerate(GOLDEN_EVENTS):
+        topology.process("stock-events", "K1", event, timestamp=i)
+    topology.flush()
+
+    got = [sequence_to_json(r.value) for r in out.records]
+    assert got == GOLDEN_MATCHES
+    assert all(r.key == "K1" for r in out.records)
+
+
+def test_stock_demo_device_multi_key_isolation_and_growth():
+    """Interleaved keys through the device path, with initial_keys=1 so the
+    key axis must grow (lane reassignment + state concat) mid-stream."""
+    builder = ComplexStreamsBuilder()
+    stream = builder.stream("stock-events")
+    out = stream.query(
+        "Stocks", stocks_pattern(), _stock_queried(),
+        runtime="tpu", batch_size=4, initial_keys=1,
+    )
+    topology = builder.build()
+
+    for i, event in enumerate(GOLDEN_EVENTS):
+        topology.process("stock-events", "K1", event, timestamp=i, offset=2 * i)
+        topology.process("stock-events", "K2", event, timestamp=i, offset=2 * i + 1)
+    topology.flush()
+
+    k1 = [sequence_to_json(r.value) for r in out.records if r.key == "K1"]
+    k2 = [sequence_to_json(r.value) for r in out.records if r.key == "K2"]
+    assert k1 == GOLDEN_MATCHES
+    assert k2 == GOLDEN_MATCHES
+
+
+def test_device_runtime_hwm_dedup():
+    """Replayed offsets below the per-(key, topic#partition) high-water mark
+    are dropped before they reach the device batch
+    (reference: CEPProcessor.java:152-160)."""
+    builder = ComplexStreamsBuilder()
+    stream = builder.stream("stock-events")
+    out = stream.query(
+        "Stocks", stocks_pattern(), _stock_queried(), runtime="tpu", batch_size=100
+    )
+    topology = builder.build()
+
+    for i, event in enumerate(GOLDEN_EVENTS):
+        topology.process("stock-events", "K1", event, timestamp=i, offset=i)
+        # Immediate replay of the same offset must be ignored.
+        topology.process("stock-events", "K1", event, timestamp=i, offset=i)
+    topology.flush()
+
+    got = [sequence_to_json(r.value) for r in out.records]
+    assert got == GOLDEN_MATCHES
+
+
 def test_stock_demo_multi_key_isolation():
     """Per-key NFA isolation: interleaved keys each produce their matches
     (reference: CEPStreamIntegrationTest.java:121-172)."""
