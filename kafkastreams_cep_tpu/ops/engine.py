@@ -74,8 +74,17 @@ class EngineConfig:
     """Capacity knobs (SURVEY.md section 5.6: typed config, not a flag framework)."""
 
     lanes: int = 64          # max simultaneous runs per key (run-lane pool)
-    nodes: int = 8192        # buffer node pool per key per batch window
-    matches: int = 1024      # match-descriptor ring per batch
+    nodes: int = 8192        # compacted node-pool region per key (post-GC)
+    matches: int = 1024      # pending-match id buffer per key (between drains)
+    #: per-(key, event-step) cap on emitted matches; one event can complete
+    #: several runs at once (branching multi-match), but rarely more than a
+    #: handful -- overflow is counted in match_drops.
+    matches_per_step: int = 16
+    #: per-(key, event-step) cap on buffer-node appends (consumed-event
+    #: writes). 0 = uncapped (lanes * max_depth slots per step). One event
+    #: consumes at most once per consuming lane; capping shrinks the
+    #: time-indexed window the post-GC sweeps. Overflow -> node_drops.
+    nodes_per_step: int = 0
     digits: int = 0          # Dewey digit width; 0 = auto (n_stages + 2)
     #: Reference parity (False): synthesized epsilon stages carry no window
     #: (Stage.java:247-251,42), so consumed runs are never expired and
@@ -92,13 +101,16 @@ class EngineConfig:
 def init_state(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndarray]:
     """Initial device state: one begin run, version `1`, run id 1.
 
-    Mirrors Stages.initialComputationStage (Stages.java:53-60).
+    Mirrors Stages.initialComputationStage (Stages.java:53-60). The node
+    pool and pending-match buffer live outside the scan carry (init_pool):
+    the per-step transition writes nodes as time-indexed scan *outputs*, so
+    the multi-megabyte pools are never copied per event step and -- crucial
+    for the vmapped multi-key path -- never updated through a per-key
+    dynamic offset, which XLA lowers to a serialized scatter inside scans.
     """
     R = config.lanes
     D = config.dewey_width(query)
     A = query.n_aggs
-    B = config.nodes
-    M = config.matches
 
     ver = np.zeros((R, D), np.int32)
     ver[0, 0] = 1
@@ -117,14 +129,6 @@ def init_state(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndar
         "regs": np.zeros((R, A), np.float32),  # fold registers (per lane)
         "regs_set": np.zeros((R, A), bool),
         "runs": np.asarray(1, np.int32),       # global run counter
-        # -- buffer node pool (slot B = overflow trash) ----------------------
-        "node_event": np.full(B + 1, -1, np.int32),   # global event index
-        "node_name": np.full(B + 1, -1, np.int32),    # stage (name, type) id
-        "node_pred": np.full(B + 1, -1, np.int32),    # predecessor node (-1 root)
-        "node_count": np.asarray(0, np.int32),
-        # -- match ring (slot M = overflow trash) ----------------------------
-        "match_node": np.full(M + 1, -1, np.int32),
-        "match_count": np.asarray(0, np.int32),
         # -- observability counters (SURVEY.md section 5.1/5.5) --------------
         "n_events": np.asarray(0, np.int32),
         "n_branches": np.asarray(0, np.int32),
@@ -141,6 +145,27 @@ def init_state(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndar
     return {k: jnp.asarray(v) for k, v in state.items()}
 
 
+def init_pool(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndarray]:
+    """The GC-owned node-pool region + pending-match buffer (per key).
+
+    Node ids < config.nodes index this compacted region; ids >= config.nodes
+    index the current advance's time-indexed window (the scan's stacked
+    outputs) until the post-advance GC folds the window back into the
+    region. `pend` holds emitted match ids (GC roots, remapped on compaction)
+    until the host drains them.
+    """
+    B = config.nodes
+    M = config.matches
+    return {
+        "node_event": jnp.full(B, -1, jnp.int32),
+        "node_name": jnp.full(B, -1, jnp.int32),
+        "node_pred": jnp.full(B, -1, jnp.int32),
+        "node_count": jnp.asarray(0, jnp.int32),
+        "pend": jnp.full(M, -1, jnp.int32),
+        "pend_count": jnp.asarray(0, jnp.int32),
+    }
+
+
 def _excl_cumsum(mask: jnp.ndarray) -> jnp.ndarray:
     c = jnp.cumsum(mask.astype(jnp.int32))
     return c - mask.astype(jnp.int32)
@@ -148,21 +173,27 @@ def _excl_cumsum(mask: jnp.ndarray) -> jnp.ndarray:
 
 def build_step(
     query: CompiledQuery, config: EngineConfig, debug: bool = False
-) -> Callable[[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]], Tuple[Dict[str, jnp.ndarray], Any]]:
+) -> Callable[..., Tuple[Dict[str, jnp.ndarray], Any]]:
     """Build the one-event transition function (a `lax.scan` body).
 
-    The returned `step(state, x)` consumes one packed event
+    The returned `step(state, x, t)` consumes one packed event
     (x = column scalars + precomputed stateless predicate row + global event
-    index + validity flag) and returns the next state. All shapes static.
+    index + validity flag; t = the event's step index within the advance)
+    and returns (next state, ys) where ys carries the step's buffer-node
+    writes in a fixed time-indexed layout -- node id = nodes + t*R*L + slot
+    -- plus up to `matches_per_step` emitted match ids. Pools stay out of
+    the carry so the scan never copies them and never needs a per-key
+    dynamic-offset update (a serialized scatter on TPU). All shapes static.
     """
     R = config.lanes
     D = config.dewey_width(query)
     A = query.n_aggs
     B = config.nodes
-    M = config.matches
+    M_STEP = config.matches_per_step
     L = query.max_depth
     P = query.n_preds
     SLOTS = 4 * L
+    P_CAP = config.nodes_per_step if config.nodes_per_step > 0 else R * L
 
     # Device-constant stage tables.
     t_consume_op = jnp.asarray(query.consume_op)
@@ -202,7 +233,7 @@ def build_step(
         onehot = (jnp.arange(D)[None, :] == idx[:, None]).astype(jnp.int32)
         return ver + onehot
 
-    def step(state: Dict[str, jnp.ndarray], x: Dict[str, jnp.ndarray]):
+    def step(state: Dict[str, jnp.ndarray], x: Dict[str, jnp.ndarray], t: jnp.ndarray):
         ev_ts = x["ts"]
         gidx = x["gidx"]
 
@@ -345,26 +376,32 @@ def build_step(
         collide = jnp.any(seq_sorted[1:] == seq_sorted[:-1])
 
         # ==== buffer puts (one per consumed level, NFA.java:238-271) ========
+        # Time-indexed window layout: step t's appends live in window slots
+        # [t*P_CAP, (t+1)*P_CAP) -- node id = B + t*P_CAP + rank -- emitted
+        # as this step's scan output. No allocation counter, no scatter, no
+        # carry traffic; empty slots carry event -1 and are swept by the
+        # post-advance GC. With P_CAP < R*L one stable argsort compacts the
+        # consumed slots to the front; overflow is counted in node_drops.
         put_flat = jnp.stack([v["c_m"] for v in levels], axis=1).reshape(-1)  # [R*L]
-        put_pos = state["node_count"] + _excl_cumsum(put_flat)
-        node_drop = put_flat & (put_pos >= B)
-        put_idx_flat = jnp.where(put_flat & ~node_drop, put_pos, B)
-        put_idx = put_idx_flat.reshape(R, L)
         cs_mat = jnp.stack([v["cs"] for v in levels], axis=1)  # [R, L]
-        node_event = state["node_event"].at[put_idx_flat].set(
-            jnp.where(put_flat, gidx, -1), mode="drop"
-        )
-        node_name = state["node_name"].at[put_idx_flat].set(
-            jnp.where(put_flat, t_name_id[cs_mat.reshape(-1)], -1), mode="drop"
-        )
-        node_pred = state["node_pred"].at[put_idx_flat].set(
-            jnp.where(put_flat, jnp.repeat(lane_node, L), -1), mode="drop"
-        )
-        # Trash slot stays clean.
-        node_event = node_event.at[B].set(-1)
-        node_name = node_name.at[B].set(-1)
-        node_pred = node_pred.at[B].set(-1)
-        new_node_count = state["node_count"] + jnp.sum(put_flat & ~node_drop).astype(jnp.int32)
+        v_event = jnp.where(put_flat, gidx, -1).astype(jnp.int32)
+        v_name = jnp.where(put_flat, t_name_id[cs_mat.reshape(-1)], -1)
+        v_pred = jnp.where(put_flat, jnp.repeat(lane_node, L), -1)
+        base = B + t * P_CAP
+        if P_CAP >= R * L:
+            put_idx = (base + jnp.arange(R * L, dtype=jnp.int32)).reshape(R, L)
+            w_event, w_name, w_pred = v_event, v_name, v_pred
+            step_node_drops = jnp.asarray(0, jnp.int32)
+        else:
+            rank = _excl_cumsum(put_flat)
+            n_put = jnp.sum(put_flat).astype(jnp.int32)
+            put_ok = put_flat & (rank < P_CAP)
+            put_idx = jnp.where(put_ok, base + rank, -1).reshape(R, L)
+            porder = jnp.argsort(~put_flat, stable=True)
+            w_event = v_event[porder][:P_CAP]
+            w_name = v_name[porder][:P_CAP]
+            w_pred = v_pred[porder][:P_CAP]
+            step_node_drops = jnp.maximum(n_put - P_CAP, 0).astype(jnp.int32)
 
         # ==== upward pass: clones / begin-re-adds (NFA.java:289-338) ========
         desc_any = jnp.zeros(R, bool)
@@ -521,50 +558,46 @@ def build_step(
         new_runs = state["runs"] + jnp.sum(newseq_flat).astype(jnp.int32)
 
         # ==== match extraction (forwarding-to-final, NFA.java:148-158) ======
+        # Up to M_STEP match ids leave as scan outputs, compacted to the
+        # front in emission order (one small stable argsort per step).
         is_match = occ & (
             ((o_eps >= 0) & t_is_final[o_eps.clip(0)])
             | ((o_eps < 0) & t_fwd_final[o_src.clip(0)])
         )
         match_flat = is_match.reshape(-1)
-        mpos = state["match_count"] + _excl_cumsum(match_flat)
-        match_drop = match_flat & (mpos >= M)
-        midx = jnp.where(match_flat & ~match_drop, mpos, M)
-        match_node = state["match_node"].at[midx].set(
-            jnp.where(match_flat, o_node.reshape(-1), -1), mode="drop"
-        )
-        match_node = match_node.at[M].set(-1)
-        new_match_count = state["match_count"] + jnp.sum(match_flat & ~match_drop).astype(
-            jnp.int32
-        )
+        n_match = jnp.sum(match_flat).astype(jnp.int32)
+        morder = jnp.argsort(~match_flat, stable=True)
+        w_match = jnp.where(match_flat, o_node.reshape(-1), -1)[morder][:M_STEP]
+        step_match_drops = jnp.maximum(n_match - M_STEP, 0)
 
         # ==== lane compaction (new queue in emission order) =================
+        # One stable argsort brings kept slots to the front in emission
+        # order; every lane field is then a plain gather of the first R --
+        # no scatters anywhere on the per-event path.
         keep = (occ & ~is_match).reshape(-1)
-        lpos = _excl_cumsum(keep)
-        lane_drop = keep & (lpos >= R)
-        lidx = jnp.where(keep & ~lane_drop, lpos, R)
+        n_keep = jnp.sum(keep).astype(jnp.int32)
+        korder = jnp.argsort(~keep, stable=True)
+        sel = korder[:R]
+        lane_ok = jnp.arange(R) < n_keep
+        lane_drop_count = jnp.maximum(n_keep - R, 0)
 
-        def scat(flat_vals, fill, extra_dims=()):
-            out = jnp.full((R + 1,) + extra_dims, fill, flat_vals.dtype)
-            out = out.at[lidx].set(
-                jnp.where(
-                    keep.reshape((-1,) + (1,) * len(extra_dims)), flat_vals, fill
-                ),
-                mode="drop",
-            )
-            return out[:R]
+        def compact(flat_vals, fill, extra_dims=()):
+            g = flat_vals.reshape((SLOTS * R,) + extra_dims)[sel]
+            mask = lane_ok.reshape((R,) + (1,) * len(extra_dims))
+            return jnp.where(mask, g, jnp.asarray(fill, g.dtype))
 
-        n_active = scat(keep, False)
-        n_src = scat(o_src.reshape(-1), 0)
-        n_eps = scat(o_eps.reshape(-1), -1)
-        n_ver = scat(o_ver.reshape(-1, D), 0, (D,))
-        n_vlen = scat(o_vlen.reshape(-1), 0)
-        n_seq = scat(o_seq.reshape(-1), 0)
-        n_node = scat(o_node.reshape(-1), -1)
-        n_ts = scat(o_ts.reshape(-1), -1)
-        n_br = scat(o_br.reshape(-1), False)
-        n_ig = scat(o_ig.reshape(-1), False)
-        n_regs = scat(o_regs.reshape(-1, A), jnp.float32(0), (A,))
-        n_regs_set = scat(o_regs_set.reshape(-1, A), False, (A,))
+        n_active = lane_ok
+        n_src = compact(o_src, 0)
+        n_eps = compact(o_eps, -1)
+        n_ver = compact(o_ver, 0, (D,))
+        n_vlen = compact(o_vlen, 0)
+        n_seq = compact(o_seq, 0)
+        n_node = compact(o_node, -1)
+        n_ts = compact(o_ts, -1)
+        n_br = compact(o_br, False)
+        n_ig = compact(o_ig, False)
+        n_regs = compact(o_regs, jnp.float32(0), (A,))
+        n_regs_set = compact(o_regs_set, False, (A,))
 
         new_state = {
             "active": n_active, "src": n_src, "eps": n_eps, "ver": n_ver,
@@ -572,24 +605,28 @@ def build_step(
             "branching": n_br, "ignored": n_ig,
             "regs": n_regs, "regs_set": n_regs_set,
             "runs": new_runs,
-            "node_event": node_event, "node_name": node_name,
-            "node_pred": node_pred, "node_count": new_node_count,
-            "match_node": match_node, "match_count": new_match_count,
             "n_events": state["n_events"] + 1,
             "n_branches": state["n_branches"]
             + jnp.sum(jnp.stack([u["clone_m"] for u in up if u is not None])).astype(jnp.int32),
             "n_expired": state["n_expired"] + jnp.sum(expired).astype(jnp.int32),
-            "lane_drops": state["lane_drops"] + jnp.sum(lane_drop).astype(jnp.int32),
-            "node_drops": state["node_drops"] + jnp.sum(node_drop).astype(jnp.int32),
-            "match_drops": state["match_drops"] + jnp.sum(match_drop).astype(jnp.int32),
+            "lane_drops": state["lane_drops"] + lane_drop_count.astype(jnp.int32),
+            "node_drops": state["node_drops"] + step_node_drops,
+            "match_drops": state["match_drops"] + step_match_drops.astype(jnp.int32),
             "seq_collisions": state["seq_collisions"] + collide.astype(jnp.int32),
         }
 
-        # Padding lanes in a batched multi-key step carry valid=False.
+        # Padding lanes in a batched multi-key step carry valid=False: the
+        # state is held and the step's outputs are masked empty.
         valid = x["valid"]
         merged = jax.tree.map(
             lambda new, old: jnp.where(valid, new, old), new_state, state
         )
+        ys = {
+            "w_event": jnp.where(valid, w_event, -1),
+            "w_name": jnp.where(valid, w_name, -1),
+            "w_pred": jnp.where(valid, w_pred, -1),
+            "w_match": jnp.where(valid, w_match, -1),
+        }
         if debug:
             dbg = dict(
                 occ=occ, o_src=o_src, o_eps=o_eps, o_seq=o_seq, o_node=o_node,
@@ -599,74 +636,135 @@ def build_step(
                 ],
                 up=[{k: v for k, v in u.items()} for u in up],
             )
-            return merged, dbg
-        return merged, None
+            return merged, (ys, dbg)
+        return merged, ys
 
     return step
 
 
-def build_gc(config: EngineConfig):
-    """Device mark-sweep compaction of the buffer node pool (single key).
+def build_post(query: CompiledQuery, config: EngineConfig):
+    """The post-advance device pass: pend-append + mark-sweep GC (one key).
 
-    The host-native analog of the reference's refcount GC
-    (SharedVersionedBufferStoreImpl.java:176-201) re-designed write-free for
-    the hot path: nodes reachable from any live lane's `node` chain are kept
-    and compacted to the front of the pool; everything else is freed. The
-    whole pass runs on device (a `lax.while_loop` predecessor walk over all
-    lanes at once + prefix-sum scatter), so no pool bytes cross the host
-    boundary. vmap-able over a leading key axis.
+    Runs once per advance (not per event step):
+
+      1. append the advance's emitted match ids (ys["w_match"]) to the
+         pool's pending buffer -- pending matches are GC *roots*, so their
+         chains survive compaction and their ids are remapped with it
+         (decode after GC is always id-consistent);
+      2. mark every node reachable from live lanes or pending matches.
+         The walk is scatter-free: the frontier (lane heads + pend ids) is
+         re-sorted each hop and membership is a vectorized searchsorted
+         against all node ids -- no per-key serialized scatters;
+      3. compact marked nodes from (region + this advance's time-indexed
+         window) into a fresh region of `config.nodes` slots via one stable
+         argsort + gathers, remapping lane pointers, node preds and pend
+         ids. Region overflow drops newest chains (node_drops).
+
+    The host analog of the reference's refcount GC
+    (SharedVersionedBufferStoreImpl.java:176-201). vmap over a leading key
+    axis for the multi-key engine (window leaves arrive as ys axis 1).
     """
     B = config.nodes
+    M = config.matches
+    R = config.lanes
+    L = query.max_depth
+    M_STEP = config.matches_per_step
 
-    def gc(state: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
-        node_pred = state["node_pred"]
-        lane_node = jnp.where(state["active"], state["node"], -1)
+    def post(
+        state: Dict[str, jnp.ndarray],
+        pool: Dict[str, jnp.ndarray],
+        ys: Dict[str, jnp.ndarray],
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        T, p_cap = ys["w_event"].shape
+        W = T * p_cap
+        w_event = ys["w_event"].reshape(W)
+        w_name = ys["w_name"].reshape(W)
+        w_pred = ys["w_pred"].reshape(W)
+
+        # -- 1. append match ids to the pending buffer (gather-based) --------
+        TM = T * M_STEP
+        m_ids = ys["w_match"].reshape(TM)
+        m_valid = m_ids >= 0
+        n_m = jnp.sum(m_valid).astype(jnp.int32)
+        m_sorted = m_ids[jnp.argsort(~m_valid, stable=True)]  # emission order
+        pc = pool["pend_count"]
+        idx = jnp.arange(M)
+        rel = idx - pc
+        take = (rel >= 0) & (rel < TM) & (rel < n_m)
+        pend = jnp.where(take, m_sorted[rel.clip(0, TM - 1)], pool["pend"])
+        new_pc = jnp.minimum(pc + n_m, M)
+        pend_drops = jnp.maximum(pc + n_m - M, 0)
+
+        # -- 2. mark reachable nodes (frontier walk) -------------------------
+        # The frontier advances one predecessor hop per iteration; marking
+        # uses a small scatter over [R + M] indices (measured cheaper on TPU
+        # than sort+searchsorted membership at these widths). Dead cursors
+        # route to a trash slot so their writes can't clobber id 0.
+        BW = B + W
+        combined_pred = jnp.concatenate([pool["node_pred"], w_pred])
+        lane_roots = jnp.where(state["active"], state["node"], -1)
+        pend_roots = jnp.where(jnp.arange(M) < new_pc, pend, -1)
+        frontier0 = jnp.concatenate([lane_roots, pend_roots])  # [R + M]
 
         def cond(carry):
-            _, cur = carry
-            return jnp.any(cur >= 0)
+            _, fr = carry
+            return jnp.any(fr >= 0)
 
         def body(carry):
-            marked, cur = carry
-            live = cur >= 0
-            # Dead cursors route to the trash slot B so their writes cannot
-            # clobber slot 0 (duplicate-index .set is last-write-wins).
-            cidx = jnp.where(live, cur, B)
-            seen = marked[cidx] & live
+            marked, fr = carry
+            live = fr >= 0
+            cidx = jnp.where(live, fr, BW)  # BW = trash slot
+            already = marked[cidx] & live
             marked = marked.at[cidx].set(True)
-            cur = jnp.where(live & ~seen, node_pred[cidx], -1)
-            return marked, cur
+            fr = jnp.where(live & ~already, combined_pred[cidx.clip(0, BW - 1)], -1)
+            return marked, fr
 
         marked, _ = jax.lax.while_loop(
-            cond, body, (jnp.zeros(B + 1, bool), lane_node)
+            cond, body, (jnp.zeros(BW + 1, bool), frontier0)
         )
-        keep = marked[:B]
-        pos = _excl_cumsum(keep)
-        remap = jnp.where(keep, pos, -1).astype(jnp.int32)  # old idx -> new
-        idx_new = jnp.where(keep, pos, B)
+        marked = marked[:BW]
 
-        def scatter(vals: jnp.ndarray, fill) -> jnp.ndarray:
-            out = jnp.full(B + 1, fill, vals.dtype)
-            out = out.at[idx_new].set(jnp.where(keep, vals, fill), mode="drop")
-            return out.at[B].set(fill)
-
-        # Index domain of stored node pointers is [-1, B] (B = trash slot).
+        # -- 3. compact into a fresh region [B] ------------------------------
+        n_keep = jnp.sum(marked).astype(jnp.int32)
+        rank = _excl_cumsum(marked)
+        remap = jnp.where(marked & (rank < B), rank, -1).astype(jnp.int32)
         remap_full = jnp.concatenate([remap, jnp.full(1, -1, jnp.int32)])
-        pred_b = node_pred[:B]
-        pred_remapped = jnp.where(pred_b >= 0, remap_full[pred_b.clip(0)], -1)
-        new_lane = jnp.where(
-            state["node"] >= 0, remap_full[state["node"].clip(0)], -1
+        sel = jnp.argsort(~marked, stable=True)[:B]
+        ok = jnp.arange(B) < jnp.minimum(n_keep, B)
+        combined_event = jnp.concatenate([pool["node_event"], w_event])
+        combined_name = jnp.concatenate([pool["node_name"], w_name])
+        pred_remapped = jnp.where(
+            combined_pred >= 0, remap_full[combined_pred.clip(0)], -1
         )
-        return {
-            **state,
-            "node_event": scatter(state["node_event"][:B], -1),
-            "node_name": scatter(state["node_name"][:B], -1),
-            "node_pred": scatter(pred_remapped, -1),
-            "node_count": jnp.sum(keep).astype(jnp.int32),
-            "node": new_lane.astype(jnp.int32),
+        new_pool = {
+            "node_event": jnp.where(ok, combined_event[sel], -1),
+            "node_name": jnp.where(ok, combined_name[sel], -1),
+            "node_pred": jnp.where(ok, pred_remapped[sel], -1),
+            "node_count": jnp.minimum(n_keep, B),
+            "pend": jnp.where(pend >= 0, remap_full[pend.clip(0)], -1),
+            "pend_count": new_pc,
         }
+        new_state = {
+            **state,
+            "node": jnp.where(
+                state["node"] >= 0, remap_full[state["node"].clip(0)], -1
+            ).astype(jnp.int32),
+            "node_drops": state["node_drops"]
+            + jnp.maximum(n_keep - B, 0).astype(jnp.int32),
+            "match_drops": state["match_drops"] + pend_drops.astype(jnp.int32),
+        }
+        return new_state, new_pool
 
-    return gc
+    return post
+
+
+def drain_pend(pool: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Clear the pending-match buffer (jit-able; keeps shardings)."""
+    return {
+        **pool,
+        "pend": jnp.full_like(pool["pend"], -1),
+        "pend_count": jnp.zeros_like(pool["pend_count"]),
+    }
 
 
 def build_batch_fn(query: CompiledQuery, config: EngineConfig):
@@ -674,14 +772,23 @@ def build_batch_fn(query: CompiledQuery, config: EngineConfig):
 
     `xs` is the packed batch: event columns ("f:*", "ts", "topic") of shape
     [T], plus "spred" [T, P] (precomputed stateless predicate rows),
-    "gidx" [T] global event indices and "valid" [T].
+    "gidx" [T] global event indices and "valid" [T]. Returns the new state
+    and ys, the stacked per-step node/match outputs consumed by build_post.
     """
     step = build_step(query, config)
 
     @jax.jit
     def advance(state, xs):
-        state, _ = jax.lax.scan(step, state, xs)
-        return state
+        T = xs["valid"].shape[0]
+
+        def body(carry, xt):
+            x, t = xt
+            return step(carry, x, t)
+
+        state, ys = jax.lax.scan(
+            body, state, (xs, jnp.arange(T, dtype=jnp.int32))
+        )
+        return state, ys
 
     return advance
 

@@ -32,8 +32,10 @@ import jax
 from .engine import (
     EngineConfig,
     build_batch_fn,
-    build_gc,
+    build_post,
+    drain_pend,
     eval_stateless_preds,
+    init_pool,
     init_state,
 )
 from .schema import EventSchema
@@ -53,7 +55,7 @@ class DeviceNFA:
         stages_or_query: Any,
         schema: Optional[EventSchema] = None,
         config: Optional[EngineConfig] = None,
-        gc_every: int = 1,
+        events_prune_threshold: int = 1 << 16,
     ) -> None:
         if isinstance(stages_or_query, CompiledQuery):
             self.query = stages_or_query
@@ -62,9 +64,13 @@ class DeviceNFA:
             self.query = compile_query(stages_or_query, schema)
         self.config = config if config is not None else EngineConfig()
         self._advance = build_batch_fn(self.query, self.config)
-        self._gc = jax.jit(build_gc(self.config))
-        self.gc_every = max(1, gc_every)
+        self._post = jax.jit(build_post(self.query, self.config))
+        self._drain_pend = jax.jit(drain_pend)
+        # The post pass (pend-append + GC) runs every advance by design:
+        # node ids are only stable across advances through its remap.
+        self.events_prune_threshold = events_prune_threshold
         self.state = init_state(self.query, self.config)
+        self.pool = init_pool(self.query, self.config)
         self._events: Dict[int, Event] = {}
         self._next_gidx = 0
         self._ts_base: Optional[int] = None
@@ -105,7 +111,7 @@ class DeviceNFA:
         node = np.asarray(self.state["node"])
         ver = np.asarray(self.state["ver"])
         vlen = np.asarray(self.state["vlen"])
-        node_event = np.asarray(self.state["node_event"])
+        node_event = np.asarray(self.pool["node_event"])
         out = []
         for i in range(len(active)):
             if not active[i]:
@@ -124,17 +130,27 @@ class DeviceNFA:
             )
         return out
 
-    def advance(self, events: List[Event]) -> List[Sequence]:
-        """Process a micro-batch; returns completed matches in oracle order."""
+    def advance(self, events: List[Event], decode: bool = True) -> List[Sequence]:
+        """Process a micro-batch; returns completed matches in oracle order.
+
+        decode=False defers match materialization (no device sync): matches
+        accumulate in the pool's pending buffer -- GC roots, so their chains
+        stay alive and id-consistent -- until `drain()`.
+        """
         if not events:
             return []
         xs = self._pack(events)
-        self.state = self._advance(self.state, xs)
-        matches = self._decode_matches()
+        self.state, ys = self._advance(self.state, xs)
+        self.state, self.pool = self._post(self.state, self.pool, ys)
         self._batches += 1
-        if self._batches % self.gc_every == 0:
-            self.state = self._gc(self.state)
-            self._prune_events()
+        if not decode:
+            return []
+        return self.drain()
+
+    def drain(self) -> List[Sequence]:
+        """Decode and clear all pending matches (a device sync point)."""
+        matches = self._decode_matches()
+        self._prune_events()
         return matches
 
     # ------------------------------------------------------------ internals
@@ -160,23 +176,23 @@ class DeviceNFA:
         return xs
 
     def _decode_matches(self) -> List[Sequence]:
-        count = int(self.state["match_count"])
+        count = int(self.pool["pend_count"])
         if count == 0:
             return []
-        match_node = np.asarray(self.state["match_node"])[:count]
-        node_event = np.asarray(self.state["node_event"])
-        node_name = np.asarray(self.state["node_name"])
-        node_pred = np.asarray(self.state["node_pred"])
+        pend = np.asarray(self.pool["pend"])[:count]
+        node_event = np.asarray(self.pool["node_event"])
+        node_name = np.asarray(self.pool["node_name"])
+        node_pred = np.asarray(self.pool["node_pred"])
 
-        chains = decode_chains(match_node, node_name, node_event, node_pred)
+        chains = decode_chains(pend, node_name, node_event, node_pred)
+        # Empty chains = pend entries whose nodes were GC-dropped under
+        # region overflow (node_drops counts them).
         out = [
             materialize_sequence(chain, self.query.name_of_id, self._events)
             for chain in chains
+            if chain
         ]
-
-        # Drain the ring.
-        self.state["match_count"] = jnp.asarray(0, np.int32)
-        self.state["match_node"] = jnp.full_like(self.state["match_node"], -1)
+        self.pool = self._drain_pend(self.pool)
         return out
 
     # --------------------------------------------------------- checkpointing
@@ -195,6 +211,7 @@ class DeviceNFA:
         w = _Writer()
         w._buf.write(MAGIC)
         w.blob(encode_array_tree({k: np.asarray(v) for k, v in self.state.items()}))
+        w.blob(encode_array_tree({k: np.asarray(v) for k, v in self.pool.items()}))
         w.blob(encode_event_registry(self._events))
         w.i64(self._next_gidx)
         w.i64(self._ts_base if self._ts_base is not None else -1)
@@ -208,7 +225,6 @@ class DeviceNFA:
         data: bytes,
         schema: Optional[EventSchema] = None,
         config: Optional[EngineConfig] = None,
-        gc_every: int = 1,
     ) -> "DeviceNFA":
         """Rebuild a DeviceNFA from `snapshot()` bytes in a fresh object
         graph (query recompiled by the caller, stages never serialized --
@@ -220,12 +236,14 @@ class DeviceNFA:
             decode_event_registry,
         )
 
-        dev = cls(stages_or_query, schema=schema, config=config, gc_every=gc_every)
+        dev = cls(stages_or_query, schema=schema, config=config)
         r = _Reader(data)
         if r._read(4) != MAGIC:
             raise ValueError("bad checkpoint magic")
         tree = decode_array_tree(r.blob())
         dev.state = {k: jnp.asarray(v) for k, v in tree.items()}
+        pool_tree = decode_array_tree(r.blob())
+        dev.pool = {k: jnp.asarray(v) for k, v in pool_tree.items()}
         dev._events = decode_event_registry(r.blob())
         dev._next_gidx = r.i64()
         ts_base = r.i64()
@@ -236,13 +254,13 @@ class DeviceNFA:
     def _prune_events(self) -> None:
         """Bound the host event registry: keep only pool-referenced events.
 
-        Runs after the on-device GC (engine.build_gc) compacted the pool, so
-        the single [B+1] `node_event` pull is the only host transfer.
+        Runs after the post-advance GC compacted the pool, so the single
+        `node_event` pull is the only host transfer -- and only once the
+        registry outgrows its threshold (a pull is a sync point).
         """
-        count = int(self.state["node_count"])
-        if len(self._events) <= count:
+        if len(self._events) <= self.events_prune_threshold:
             return
-        live = np.asarray(self.state["node_event"])[:count]
+        live = np.asarray(self.pool["node_event"])
         live_gidx = set(int(g) for g in live[live >= 0])
         self._events = {g: e for g, e in self._events.items() if g in live_gidx}
 

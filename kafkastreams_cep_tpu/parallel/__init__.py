@@ -4,7 +4,9 @@ from .batched import BatchedDeviceNFA
 from .key_shard import (
     KEY_AXIS,
     build_batched_advance,
+    build_batched_post,
     global_stats,
+    init_batched_pool,
     init_batched_state,
     key_mesh,
     key_sharding,
@@ -16,7 +18,9 @@ __all__ = [
     "BatchedDeviceNFA",
     "KEY_AXIS",
     "build_batched_advance",
+    "build_batched_post",
     "global_stats",
+    "init_batched_pool",
     "init_batched_state",
     "key_mesh",
     "key_sharding",

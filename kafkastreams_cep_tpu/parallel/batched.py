@@ -16,6 +16,7 @@ pool GC at a configurable cadence.
 """
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Dict, List, Mapping, Optional, Sequence as Seq, Tuple
 
 import numpy as np
@@ -25,13 +26,15 @@ import jax.numpy as jnp
 
 from ..core.event import Event
 from ..core.sequence import Sequence
-from ..ops.engine import EngineConfig, build_gc, eval_stateless_preds, init_state
+from ..ops.engine import EngineConfig, drain_pend, eval_stateless_preds
 from ..ops.runtime import decode_chains, materialize_sequence
 from ..ops.schema import EventSchema
 from ..ops.tables import CompiledQuery, compile_query
 from ..pattern.stages import Stages
 from .key_shard import (
     build_batched_advance,
+    build_batched_post,
+    init_batched_pool,
     init_batched_state,
     key_sharding,
     shard_state,
@@ -55,7 +58,6 @@ class BatchedDeviceNFA:
         schema: Optional[EventSchema] = None,
         config: Optional[EngineConfig] = None,
         mesh: Optional[Any] = None,
-        gc_every: int = 1,
         events_prune_threshold: int = 1 << 16,
     ) -> None:
         if isinstance(stages_or_query, CompiledQuery):
@@ -79,18 +81,24 @@ class BatchedDeviceNFA:
         self.key_index: Dict[Any, int] = {k: i for i, k in enumerate(self.keys)}
 
         self.state = init_batched_state(self.query, self.config, self.K_padded)
+        self.pool = init_batched_pool(self.query, self.config, self.K_padded)
         if mesh is not None:
             self.state = shard_state(self.state, mesh)
+            self.pool = shard_state(self.pool, mesh)
         self._advance = build_batched_advance(self.query, self.config)
-        self._gc = jax.jit(jax.vmap(build_gc(self.config)))
-        self._drain = jax.jit(_drain_match_ring)
-        self.gc_every = max(1, gc_every)
+        self._post = build_batched_post(self.query, self.config)
+        self._drain_pend = jax.jit(drain_pend)
+        # post (pend-append + GC) runs every advance: node ids are only
+        # stable across advances through its remap.
         self.events_prune_threshold = events_prune_threshold
         self._events: Dict[int, Event] = {}
         self._next_gidx = 0
         #: highest gidx already advanced through the engine; events above it
         #: were packed ahead (pipelined ingest) and must survive pruning.
+        #: Maintained host-side via a FIFO of per-pack watermarks (batches
+        #: must be advanced in pack order -- stream semantics).
         self._processed_gidx = -1
+        self._pack_hwms: deque = deque()
         self._ts_base: Optional[int] = None
         self._batches = 0
         self._stats_fn = None
@@ -114,15 +122,17 @@ class BatchedDeviceNFA:
         delta = k_pad - self.K_padded
         self.key_index = {k: i for i, k in enumerate(self.keys)}
         if delta > 0:
-            fresh = init_batched_state(self.query, self.config, delta)
+            cat = lambda old, new: jnp.concatenate([old, new], axis=0)
             self.state = jax.tree.map(
-                lambda old, new: jnp.concatenate([old, new], axis=0),
-                self.state,
-                fresh,
+                cat, self.state, init_batched_state(self.query, self.config, delta)
+            )
+            self.pool = jax.tree.map(
+                cat, self.pool, init_batched_pool(self.query, self.config, delta)
             )
             self.K_padded = k_pad
             if self.mesh is not None:
                 self.state = shard_state(self.state, self.mesh)
+                self.pool = shard_state(self.pool, self.mesh)
 
     @property
     def stats(self) -> Dict[str, int]:
@@ -209,6 +219,7 @@ class BatchedDeviceNFA:
         xs["valid"] = jnp.asarray(valid)
         if self.mesh is not None:
             xs = shard_xs(xs, self.mesh)
+        self._pack_hwms.append(self._next_gidx - 1)
         return xs
 
     def advance(
@@ -222,24 +233,35 @@ class BatchedDeviceNFA:
     ) -> Dict[Any, List[Sequence]]:
         """Advance with pre-packed columns (the bench/pipelined ingest path).
 
-        With decode=False the match ring is drained but not materialized into
-        host Sequences; `last_match_counts` holds the per-key totals.
+        With decode=False the call is fully asynchronous -- no device sync,
+        matches accumulate in the (padded) ring until `drain()` or the next
+        decoding advance. Size `EngineConfig.matches` for the accumulation
+        window; overflow shows up in `stats["match_drops"]`.
         """
-        self._processed_gidx = max(
-            self._processed_gidx, int(np.asarray(xs["gidx"]).max())
-        )
-        self.state = self._advance(self.state, xs)
-        counts = np.asarray(self.state["match_count"])
-        out: Dict[Any, List[Sequence]] = {}
-        if decode and counts.sum() > 0:
-            out = self._decode_matches(counts)
-        self.last_match_counts = counts
-        if counts.sum() > 0:
-            self.state = self._drain(self.state)
+        if self._pack_hwms:
+            self._processed_gidx = max(
+                self._processed_gidx, self._pack_hwms.popleft()
+            )
+        self.state, ys = self._advance(self.state, xs)
+        self.state, self.pool = self._post(self.state, self.pool, ys)
         self._batches += 1
-        if self._batches % self.gc_every == 0:
-            self.state = self._gc(self.state)
-            self._prune_events()
+        out: Dict[Any, List[Sequence]] = {}
+        if decode:
+            out = self.drain()
+        return out
+
+    def drain(self) -> Dict[Any, List[Sequence]]:
+        """Decode and clear all pending matches (a device sync point).
+
+        Pending ids are GC roots, remapped on every post pass, so draining
+        after any number of non-decoding advances is id-consistent."""
+        counts = np.asarray(self.pool["pend_count"])
+        self.last_match_counts = counts
+        self._prune_events()  # registry must stay bounded on match-free streams
+        if counts.sum() == 0:
+            return {}
+        out = self._decode_matches(counts)
+        self.pool = self._drain_pend(self.pool)
         return out
 
     # --------------------------------------------------------- checkpointing
@@ -258,6 +280,7 @@ class BatchedDeviceNFA:
         w._buf.write(MAGIC)
         w.blob(pickle.dumps(self.keys, protocol=pickle.HIGHEST_PROTOCOL))
         w.blob(encode_array_tree({k: np.asarray(v) for k, v in self.state.items()}))
+        w.blob(encode_array_tree({k: np.asarray(v) for k, v in self.pool.items()}))
         w.blob(encode_event_registry(self._events))
         w.i64(self._next_gidx)
         w.i64(self._ts_base if self._ts_base is not None else -1)
@@ -272,7 +295,6 @@ class BatchedDeviceNFA:
         schema: Optional[EventSchema] = None,
         config: Optional[EngineConfig] = None,
         mesh: Optional[Any] = None,
-        gc_every: int = 1,
     ) -> "BatchedDeviceNFA":
         import pickle
 
@@ -288,14 +310,17 @@ class BatchedDeviceNFA:
             raise ValueError("bad checkpoint magic")
         keys = pickle.loads(r.blob())
         bat = cls(
-            stages_or_query, keys=keys, schema=schema, config=config,
-            mesh=mesh, gc_every=gc_every,
+            stages_or_query, keys=keys, schema=schema, config=config, mesh=mesh,
         )
         tree = decode_array_tree(r.blob())
         state = {k: jnp.asarray(v) for k, v in tree.items()}
+        pool_tree = decode_array_tree(r.blob())
+        pool = {k: jnp.asarray(v) for k, v in pool_tree.items()}
         if mesh is not None:
             state = shard_state(state, mesh)
+            pool = shard_state(pool, mesh)
         bat.state = state
+        bat.pool = pool
         bat.K_padded = int(tree["active"].shape[0])
         bat._events = decode_event_registry(r.blob())
         bat._next_gidx = r.i64()
@@ -307,15 +332,15 @@ class BatchedDeviceNFA:
 
     # ------------------------------------------------------------ internals
     def _decode_matches(self, counts: np.ndarray) -> Dict[Any, List[Sequence]]:
-        match_node = np.asarray(self.state["match_node"])  # [K, M+1]
-        node_event = np.asarray(self.state["node_event"])  # [K, B+1]
-        node_name = np.asarray(self.state["node_name"])
-        node_pred = np.asarray(self.state["node_pred"])
-        K, Bp1 = node_event.shape
+        pend = np.asarray(self.pool["pend"])            # [K, M]
+        node_event = np.asarray(self.pool["node_event"])  # [K, B]
+        node_name = np.asarray(self.pool["node_name"])
+        node_pred = np.asarray(self.pool["node_pred"])
+        K, B = node_event.shape
 
         # Flatten per-key pools into one index space so every chain across
         # every key walks in the same vectorized pass.
-        key_base = (np.arange(K, dtype=np.int64) * Bp1)[:, None]
+        key_base = (np.arange(K, dtype=np.int64) * B)[:, None]
         flat_pred = np.where(node_pred >= 0, node_pred + key_base, -1).reshape(-1)
         flat_event = node_event.reshape(-1)
         flat_name = node_name.reshape(-1)
@@ -323,15 +348,17 @@ class BatchedDeviceNFA:
         starts: List[int] = []
         match_key: List[int] = []
         for k in range(K):
-            c = int(counts[k])
-            for j in range(c):
-                starts.append(int(match_node[k, j]) + k * Bp1)
+            for j in range(int(counts[k])):
+                nid = int(pend[k, j])
+                starts.append(nid + k * B if nid >= 0 else -1)
                 match_key.append(k)
         chains = decode_chains(
             np.asarray(starts, np.int64), flat_name, flat_event, flat_pred
         )
         out: Dict[Any, List[Sequence]] = {}
         for k_idx, chain in zip(match_key, chains):
+            if not chain:
+                continue  # GC-dropped under overflow (node_drops counts it)
             key = self.keys[k_idx]
             out.setdefault(key, []).append(
                 materialize_sequence(chain, self.query.name_of_id, self._events)
@@ -344,7 +371,7 @@ class BatchedDeviceNFA:
         registers events before their batch is advanced)."""
         if len(self._events) <= self.events_prune_threshold:
             return
-        live = np.asarray(self.state["node_event"])
+        live = np.asarray(self.pool["node_event"])
         live_gidx = set(int(g) for g in live[live >= 0])
         hwm = self._processed_gidx
         self._events = {
@@ -352,10 +379,3 @@ class BatchedDeviceNFA:
         }
 
 
-def _drain_match_ring(state: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
-    """Clear the match ring on device (keeps shardings intact under jit)."""
-    return {
-        **state,
-        "match_count": jnp.zeros_like(state["match_count"]),
-        "match_node": jnp.full_like(state["match_node"], -1),
-    }

@@ -23,22 +23,32 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.engine import EngineConfig, build_step, init_state
+from ..ops.engine import EngineConfig, build_post, build_step, init_pool, init_state
 from ..ops.tables import CompiledQuery
 
 #: Mesh axis name for the key shard (data-parallel axis).
 KEY_AXIS = "keys"
 
 
+def _broadcast_tree(tree: Dict[str, jnp.ndarray], n_keys: int) -> Dict[str, jnp.ndarray]:
+    return jax.tree.map(
+        lambda leaf: jnp.broadcast_to(leaf[None, ...], (n_keys,) + leaf.shape).copy(),
+        tree,
+    )
+
+
 def init_batched_state(
     query: CompiledQuery, config: EngineConfig, n_keys: int
 ) -> Dict[str, jnp.ndarray]:
     """Per-key engine state stacked along a leading [K] axis."""
-    single = init_state(query, config)
-    return jax.tree.map(
-        lambda leaf: jnp.broadcast_to(leaf[None, ...], (n_keys,) + leaf.shape).copy(),
-        single,
-    )
+    return _broadcast_tree(init_state(query, config), n_keys)
+
+
+def init_batched_pool(
+    query: CompiledQuery, config: EngineConfig, n_keys: int
+) -> Dict[str, jnp.ndarray]:
+    """Per-key node pool / pending-match buffer stacked along [K]."""
+    return _broadcast_tree(init_pool(query, config), n_keys)
 
 
 def build_batched_advance(query: CompiledQuery, config: EngineConfig):
@@ -46,21 +56,37 @@ def build_batched_advance(query: CompiledQuery, config: EngineConfig):
 
     xs leaves are time-major [T, K, ...]: the scan walks events in lockstep
     across keys (each key sees its own column slice; padding steps carry
-    valid=False). Returns the new [K]-stacked state.
+    valid=False). The step index is scanned *unbatched* (in_axes=None) so
+    the time-indexed node-window layout stays shared across keys. Returns
+    (new [K]-stacked state, ys with leaves [T, K, ...]).
     """
     step = build_step(query, config)
-    vstep = jax.vmap(step, in_axes=(0, 0))
+    vstep = jax.vmap(step, in_axes=(0, 0, None))
 
     @jax.jit
     def advance(state, xs):
-        def body(carry, x):
-            new, _ = vstep(carry, x)
-            return new, None
+        T = xs["valid"].shape[0]
 
-        state, _ = jax.lax.scan(body, state, xs)
-        return state
+        def body(carry, xt):
+            x, t = xt
+            return vstep(carry, x, t)
+
+        state, ys = jax.lax.scan(
+            body, state, (xs, jnp.arange(T, dtype=jnp.int32))
+        )
+        return state, ys
 
     return advance
+
+
+def build_batched_post(query: CompiledQuery, config: EngineConfig):
+    """jit-compiled multi-key post pass (pend-append + GC), vmapped over K.
+
+    ys leaves arrive time-major [T, K, ...] straight from the batched
+    advance; the vmap maps them over axis 1.
+    """
+    post = build_post(query, config)
+    return jax.jit(jax.vmap(post, in_axes=(0, 0, 1)))
 
 
 def key_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -96,6 +122,6 @@ def global_stats(state: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     keys = (
         "n_events", "n_branches", "n_expired",
         "lane_drops", "node_drops", "match_drops", "seq_collisions",
-        "match_count", "runs",
+        "runs",
     )
     return {k: jnp.sum(state[k]) for k in keys}

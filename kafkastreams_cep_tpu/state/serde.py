@@ -30,7 +30,7 @@ from .aggregates import AggregatesStore
 from .buffer import BufferNode, BufferStore, SharedVersionedBuffer
 from .nfa_store import NFAStates, NFAStore
 
-MAGIC = b"KCT1"  # format tag + version
+MAGIC = b"KCT2"  # format tag + version (2: pool/pend split out of engine state)
 
 
 def _default_serialize(obj: Any) -> bytes:

@@ -48,7 +48,6 @@ class DeviceCEPProcessor(Generic[K, V]):
         batch_size: int = 64,
         initial_keys: int = 8,
         mesh: Optional[Any] = None,
-        gc_every: int = 1,
     ) -> None:
         if isinstance(pattern_or_query, CompiledQuery):
             self.query = pattern_or_query
@@ -65,7 +64,6 @@ class DeviceCEPProcessor(Generic[K, V]):
             keys=[_Lane(i) for i in range(self._capacity)],
             config=self.config,
             mesh=mesh,
-            gc_every=gc_every,
         )
         self._lane_of_key: Dict[Any, _Lane] = {}
         self._next_lane = 0
@@ -151,7 +149,6 @@ class DeviceCEPProcessor(Generic[K, V]):
         config: Optional[EngineConfig] = None,
         batch_size: int = 64,
         mesh: Optional[Any] = None,
-        gc_every: int = 1,
     ) -> "DeviceCEPProcessor":
         import pickle
 
@@ -159,13 +156,13 @@ class DeviceCEPProcessor(Generic[K, V]):
 
         proc = cls(
             query_name, pattern_or_query, schema=schema, config=config,
-            batch_size=batch_size, mesh=mesh, gc_every=gc_every,
+            batch_size=batch_size, mesh=mesh,
         )
         r = _Reader(data)
         if r._read(4) != MAGIC:
             raise ValueError("bad checkpoint magic")
         proc.engine = BatchedDeviceNFA.restore(
-            proc.query, r.blob(), config=proc.config, mesh=mesh, gc_every=gc_every
+            proc.query, r.blob(), config=proc.config, mesh=mesh
         )
         proc._capacity = len(proc.engine.keys)
         proc._lane_of_key = {

@@ -33,7 +33,7 @@ from kafkastreams_cep_tpu.pattern.expressions import agg, value
 from kafkastreams_cep_tpu.streams.device_processor import DeviceCEPProcessor
 
 # skip-any + one_or_more is exponential (see test_differential.py CONFIG)
-CONFIG = EngineConfig(lanes=2048, nodes=8192, matches=2048)
+CONFIG = EngineConfig(lanes=2048, nodes=8192, matches=2048, matches_per_step=2048)
 
 
 def _roundtrip(tmp_path, blob: bytes) -> bytes:

@@ -33,7 +33,7 @@ ALPHABET = ["A", "B", "C", "D"]
 # skip_til_any + unbounded cardinality is exponential by SASE semantics:
 # 24 events can legitimately produce >1400 simultaneous runs. Lane count
 # scales device memory, not compile time, so size for the worst seed.
-CONFIG = EngineConfig(lanes=2048, nodes=8192, matches=2048)
+CONFIG = EngineConfig(lanes=2048, nodes=8192, matches=2048, matches_per_step=2048)
 
 
 def random_pattern(rng: random.Random):
@@ -126,6 +126,7 @@ def test_differential(seed):
     got = dev.advance(events[:split]) + dev.advance(events[split:])
 
     assert dev.stats["lane_drops"] == 0 and dev.stats["node_drops"] == 0
+    assert dev.stats["match_drops"] == 0
     assert got == expected
     assert dev.runs == oracle.runs
     assert dev.n_live == len(oracle.computation_stages)
@@ -200,8 +201,7 @@ def test_differential_extended(seed):
 
     dev = DeviceNFA(
         compile_pattern(pattern),
-        config=_EC(lanes=512, nodes=4096, matches=512, strict_windows=True),
-        gc_every=rng.choice([1, 2, 4]),
+        config=_EC(lanes=512, nodes=4096, matches=512, matches_per_step=512, strict_windows=True),
     )
     got = []
     # Random batch splits, including single-event boundaries: batch edges
@@ -213,6 +213,7 @@ def test_differential_extended(seed):
         i += step
 
     assert dev.stats["lane_drops"] == 0 and dev.stats["node_drops"] == 0
+    assert dev.stats["match_drops"] == 0
     assert got == expected
     assert dev.runs == oracle.runs
     assert dev.n_live == len(oracle.computation_stages)

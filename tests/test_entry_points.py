@@ -21,7 +21,7 @@ def _load_entry_module():
 def test_entry_compiles_and_runs():
     mod = _load_entry_module()
     fn, args = mod.entry()
-    out = fn(*args)
+    out, _ys = fn(*args)
     jax.block_until_ready(out["n_events"])
     assert int(out["n_events"]) == 8  # the 8 golden stock events
 

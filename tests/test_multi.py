@@ -1,0 +1,131 @@
+"""Multi-topic queries and multiple concurrent queries.
+
+Mirrors the reference's multi-topic integration scenario
+(reference: core/.../cep/CEPStreamIntegrationTest.java:70-83,176-231): a
+3-stage query whose stage-2 selects only from topic t1 and stage-3 only
+from topic t2, fed an interleaved two-topic stream -- and the reference's
+N-queries-per-stream topology shape (CEPStreamImpl.java:80-93: one
+processor node per query). Both run through the host runtime and the
+batched device runtime.
+"""
+import pytest
+
+from kafkastreams_cep_tpu import (
+    ComplexStreamsBuilder,
+    QueryBuilder,
+    Selected,
+    sequence_to_json,
+)
+from kafkastreams_cep_tpu.models.letters import letters_pattern
+from kafkastreams_cep_tpu.pattern.expressions import agg, value
+
+
+def multi_topic_pattern():
+    """Expression form of PATTERN_MULTIPLE_TOPICS (runs host + device)."""
+    return (
+        QueryBuilder()
+        .select("stage-1", Selected.with_strict_contiguity())
+        .where(value() == 0)
+        .fold("sum", value())
+        .then()
+        .select("stage-2", Selected.with_skip_til_next_match().with_topic("t1"))
+        .one_or_more()
+        .where(agg("sum", default=0) <= 10)
+        .fold("sum", agg("sum", default=0) + value())
+        .then()
+        .select("stage-3", Selected.with_skip_til_any_match().with_topic("t2"))
+        .where(value() >= agg("sum", default=0))
+        .within(hours=1)
+        .build()
+    )
+
+
+def multi_topic_pattern_host():
+    """Closure form (StatefulMatcher surface; host runtime only)."""
+    return (
+        QueryBuilder()
+        .select("stage-1", Selected.with_strict_contiguity())
+        .where(lambda event, states: event.value == 0)
+        .fold("sum", lambda k, v, curr: v)
+        .then()
+        .select("stage-2", Selected.with_skip_til_next_match().with_topic("t1"))
+        .one_or_more()
+        .where(lambda event, states: states.get("sum") <= 10)
+        .fold("sum", lambda k, v, curr: curr + v)
+        .then()
+        .select("stage-3", Selected.with_skip_til_any_match().with_topic("t2"))
+        .where(lambda event, states: event.value >= states.get("sum"))
+        .within(hours=1)
+        .build()
+    )
+
+
+#: (topic, value) feed and the two expected matches
+#: (CEPStreamIntegrationTest.java:188-231).
+MULTI_TOPIC_FEED = [
+    ("t1", 0), ("t1", 1), ("t1", 2), ("t1", 3), ("t2", 6), ("t2", 10),
+]
+MULTI_TOPIC_GOLDEN = [
+    '{"events":[{"name":"stage-1","events":[0]},{"name":"stage-2","events":[1,2,3]},{"name":"stage-3","events":[6]}]}',
+    '{"events":[{"name":"stage-1","events":[0]},{"name":"stage-2","events":[1,2,3]},{"name":"stage-3","events":[10]}]}',
+]
+
+
+def _drive_multi_topic(pattern, runtime):
+    builder = ComplexStreamsBuilder()
+    stream = builder.stream(["t1", "t2"])
+    out = stream.query("multi", pattern, runtime=runtime, batch_size=100)
+    topology = builder.build()
+    for i, (topic, v) in enumerate(MULTI_TOPIC_FEED):
+        topology.process(topic, "K1", v, timestamp=i, offset=i)
+    topology.flush()
+    return [sequence_to_json(r.value) for r in out.records]
+
+
+@pytest.mark.parametrize("pattern_fn", [multi_topic_pattern, multi_topic_pattern_host])
+def test_multi_topic_host(pattern_fn):
+    assert _drive_multi_topic(pattern_fn(), "host") == MULTI_TOPIC_GOLDEN
+
+
+def test_multi_topic_device():
+    assert _drive_multi_topic(multi_topic_pattern(), "tpu") == MULTI_TOPIC_GOLDEN
+
+
+# ---------------------------------------------------------------------------
+# N concurrent queries over one stream (BASELINE config 4 shape)
+# ---------------------------------------------------------------------------
+def second_pattern():
+    return (
+        QueryBuilder()
+        .select("sel-B").where(value() == "B")
+        .then()
+        .select("sel-C").where(value() == "C")
+        .build()
+    )
+
+
+LETTER_FEED = ["A", "B", "C", "X", "B", "C", "A", "B", "C"]
+
+
+@pytest.mark.parametrize("runtime", ["host", "tpu"])
+def test_two_queries_one_stream(runtime):
+    """Two queries registered on one topic each produce their own matches
+    (reference: one processor node per query, CEPStreamImpl.java:80-93)."""
+    builder = ComplexStreamsBuilder()
+    stream = builder.stream("letters")
+    out1 = stream.query("abc", letters_pattern(), runtime=runtime, batch_size=100)
+    out2 = stream.query("bc", second_pattern(), runtime=runtime, batch_size=100)
+    topology = builder.build()
+    for i, v in enumerate(LETTER_FEED):
+        topology.process("letters", "K1", v, timestamp=i, offset=i)
+    topology.flush()
+
+    abc = [sequence_to_json(r.value) for r in out1.records]
+    bc = [sequence_to_json(r.value) for r in out2.records]
+    assert abc == [
+        '{"events":[{"name":"select-A","events":["A"]},{"name":"select-B","events":["B"]},{"name":"select-C","events":["C"]}]}',
+        '{"events":[{"name":"select-A","events":["A"]},{"name":"select-B","events":["B"]},{"name":"select-C","events":["C"]}]}',
+    ]
+    assert bc == [
+        '{"events":[{"name":"sel-B","events":["B"]},{"name":"sel-C","events":["C"]}]}',
+    ] * 3
