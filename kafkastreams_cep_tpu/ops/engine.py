@@ -661,8 +661,8 @@ def build_post(query: CompiledQuery, config: EngineConfig):
          ids. Region overflow drops newest chains (node_drops).
 
     The host analog of the reference's refcount GC
-    (SharedVersionedBufferStoreImpl.java:176-201). vmap over a leading key
-    axis for the multi-key engine (window leaves arrive as ys axis 1).
+    (SharedVersionedBufferStoreImpl.java:176-201). vmap over the trailing
+    key axis for the multi-key engine (key_shard.build_batched_post).
     """
     B = config.nodes
     M = config.matches

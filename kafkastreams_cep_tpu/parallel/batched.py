@@ -36,7 +36,6 @@ from .key_shard import (
     build_batched_post,
     init_batched_pool,
     init_batched_state,
-    key_sharding,
     shard_state,
     shard_xs,
 )
@@ -122,7 +121,7 @@ class BatchedDeviceNFA:
         delta = k_pad - self.K_padded
         self.key_index = {k: i for i, k in enumerate(self.keys)}
         if delta > 0:
-            cat = lambda old, new: jnp.concatenate([old, new], axis=0)
+            cat = lambda old, new: jnp.concatenate([old, new], axis=-1)
             self.state = jax.tree.map(
                 cat, self.state, init_batched_state(self.query, self.config, delta)
             )
@@ -154,7 +153,7 @@ class BatchedDeviceNFA:
 
     def n_live(self, key: Any) -> int:
         return int(
-            np.sum(np.asarray(self.state["active"])[self.key_index[key]])
+            np.sum(np.asarray(self.state["active"])[:, self.key_index[key]])
         )
 
     def pack(
@@ -255,7 +254,7 @@ class BatchedDeviceNFA:
 
         Pending ids are GC roots, remapped on every post pass, so draining
         after any number of non-decoding advances is id-consistent."""
-        counts = np.asarray(self.pool["pend_count"])
+        counts = np.asarray(self.pool["pend_count"])  # [K] (1-D; K-last = K-only)
         self.last_match_counts = counts
         self._prune_events()  # registry must stay bounded on match-free streams
         if counts.sum() == 0:
@@ -321,7 +320,7 @@ class BatchedDeviceNFA:
             pool = shard_state(pool, mesh)
         bat.state = state
         bat.pool = pool
-        bat.K_padded = int(tree["active"].shape[0])
+        bat.K_padded = int(tree["active"].shape[-1])
         bat._events = decode_event_registry(r.blob())
         bat._next_gidx = r.i64()
         bat._processed_gidx = bat._next_gidx - 1  # no pre-packed xs survive
@@ -332,10 +331,10 @@ class BatchedDeviceNFA:
 
     # ------------------------------------------------------------ internals
     def _decode_matches(self, counts: np.ndarray) -> Dict[Any, List[Sequence]]:
-        pend = np.asarray(self.pool["pend"])            # [K, M]
-        node_event = np.asarray(self.pool["node_event"])  # [K, B]
-        node_name = np.asarray(self.pool["node_name"])
-        node_pred = np.asarray(self.pool["node_pred"])
+        pend = np.asarray(self.pool["pend"]).T            # [K, M]
+        node_event = np.asarray(self.pool["node_event"]).T  # [K, B]
+        node_name = np.asarray(self.pool["node_name"]).T
+        node_pred = np.asarray(self.pool["node_pred"]).T
         K, B = node_event.shape
 
         # Flatten per-key pools into one index space so every chain across

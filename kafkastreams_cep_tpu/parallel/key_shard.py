@@ -4,7 +4,7 @@ The reference's only parallelism mechanism is Kafka partitioning: one stream
 task per partition, one NFA per record key inside a task
 (reference: core/.../cep/processor/CEPProcessor.java:111-124,139; SURVEY.md
 section 2.8). The TPU-native equivalent is a *batched* engine: the one-event
-transition kernel (ops/engine.py) is vmapped over a leading key axis, so one
+transition kernel (ops/engine.py) is vmapped over a trailing key axis, so one
 chip advances thousands of independent per-key NFAs in lockstep, and the key
 axis is sharded across a `jax.sharding.Mesh` for multi-chip scale-out.
 
@@ -31,8 +31,12 @@ KEY_AXIS = "keys"
 
 
 def _broadcast_tree(tree: Dict[str, jnp.ndarray], n_keys: int) -> Dict[str, jnp.ndarray]:
+    # Key axis LAST: TPU tiles pad the two minor dims to (8, 128); the
+    # engine's per-key tensors have small trailing dims (Dewey digits, slot
+    # counts, lane counts), so a leading key axis wastes up to ~16x memory
+    # bandwidth in padding. K-last makes the minor dim the (large) key axis.
     return jax.tree.map(
-        lambda leaf: jnp.broadcast_to(leaf[None, ...], (n_keys,) + leaf.shape).copy(),
+        lambda leaf: jnp.broadcast_to(leaf[..., None], leaf.shape + (n_keys,)).copy(),
         tree,
     )
 
@@ -40,14 +44,14 @@ def _broadcast_tree(tree: Dict[str, jnp.ndarray], n_keys: int) -> Dict[str, jnp.
 def init_batched_state(
     query: CompiledQuery, config: EngineConfig, n_keys: int
 ) -> Dict[str, jnp.ndarray]:
-    """Per-key engine state stacked along a leading [K] axis."""
+    """Per-key engine state stacked along a trailing [..., K] axis."""
     return _broadcast_tree(init_state(query, config), n_keys)
 
 
 def init_batched_pool(
     query: CompiledQuery, config: EngineConfig, n_keys: int
 ) -> Dict[str, jnp.ndarray]:
-    """Per-key node pool / pending-match buffer stacked along [K]."""
+    """Per-key node pool / pending-match buffer stacked along [..., K]."""
     return _broadcast_tree(init_pool(query, config), n_keys)
 
 
@@ -57,11 +61,12 @@ def build_batched_advance(query: CompiledQuery, config: EngineConfig):
     xs leaves are time-major [T, K, ...]: the scan walks events in lockstep
     across keys (each key sees its own column slice; padding steps carry
     valid=False). The step index is scanned *unbatched* (in_axes=None) so
-    the time-indexed node-window layout stays shared across keys. Returns
-    (new [K]-stacked state, ys with leaves [T, K, ...]).
+    the time-indexed node-window layout stays shared across keys. State and
+    ys leaves carry the key axis LAST (TPU tiling: the minor dim should be
+    the large axis); returns (new [..., K] state, ys leaves [T, ..., K]).
     """
     step = build_step(query, config)
-    vstep = jax.vmap(step, in_axes=(0, 0, None))
+    vstep = jax.vmap(step, in_axes=(-1, 0, None), out_axes=(-1, -1))
 
     @jax.jit
     def advance(state, xs):
@@ -82,11 +87,11 @@ def build_batched_advance(query: CompiledQuery, config: EngineConfig):
 def build_batched_post(query: CompiledQuery, config: EngineConfig):
     """jit-compiled multi-key post pass (pend-append + GC), vmapped over K.
 
-    ys leaves arrive time-major [T, K, ...] straight from the batched
-    advance; the vmap maps them over axis 1.
+    State, pool and ys leaves all carry the key axis last; the vmap maps
+    every argument over its trailing axis.
     """
     post = build_post(query, config)
-    return jax.jit(jax.vmap(post, in_axes=(0, 0, 1)))
+    return jax.jit(jax.vmap(post, in_axes=(-1, -1, -1), out_axes=(-1, -1)))
 
 
 def key_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -98,13 +103,18 @@ def key_mesh(n_devices: Optional[int] = None) -> Mesh:
 
 
 def key_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard the leading key axis; everything else replicated per shard."""
+    """Sharding for a 1-D per-key leaf ([K]) over the key axis."""
     return NamedSharding(mesh, P(KEY_AXIS))
 
 
 def shard_state(state: Dict[str, jnp.ndarray], mesh: Mesh) -> Dict[str, jnp.ndarray]:
-    sharding = key_sharding(mesh)
-    return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), state)
+    """Shard the trailing key axis of every leaf over the mesh."""
+
+    def put(leaf):
+        spec = P(*([None] * (leaf.ndim - 1) + [KEY_AXIS]))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, state)
 
 
 def shard_xs(xs: Dict[str, jnp.ndarray], mesh: Mesh) -> Dict[str, jnp.ndarray]:

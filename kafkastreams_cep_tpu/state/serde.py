@@ -30,7 +30,7 @@ from .aggregates import AggregatesStore
 from .buffer import BufferNode, BufferStore, SharedVersionedBuffer
 from .nfa_store import NFAStates, NFAStore
 
-MAGIC = b"KCT2"  # format tag + version (2: pool/pend split out of engine state)
+MAGIC = b"KCT3"  # format tag + version (3: batched leaves store the key axis last)
 
 
 def _default_serialize(obj: Any) -> bytes:

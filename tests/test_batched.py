@@ -142,7 +142,7 @@ def test_batched_sharded_over_mesh():
     # State really is sharded along the key axis.
     sh = bat.state["active"].sharding
     assert isinstance(sh, NamedSharding)
-    assert sh.spec and sh.spec[0] == KEY_AXIS
+    assert sh.spec and sh.spec[-1] == KEY_AXIS  # key axis is the minor dim
     assert got == want
 
 
