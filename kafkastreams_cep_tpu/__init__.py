@@ -22,7 +22,10 @@ from .nfa.nfa import NFA, ComputationStage, initial_computation_stage
 from .state.aggregates import AggregatesStore, States, UnknownAggregateException
 from .state.buffer import SharedVersionedBuffer
 from .state.nfa_store import NFAStates, NFAStore
+from .state.builders import QueryStoreBuilders
 from .streams.builder import ComplexStreamsBuilder
+from .streams.driver import LogDriver, produce
+from .streams.log import RecordLog
 from .streams.processor import CEPProcessor
 from .streams.serde import Queried, sequence_to_json
 
@@ -85,6 +88,10 @@ __all__ = [
     "NFAStore",
     "ComplexStreamsBuilder",
     "CEPProcessor",
+    "LogDriver",
+    "QueryStoreBuilders",
+    "RecordLog",
+    "produce",
     "Queried",
     "sequence_to_json",
     # lazy device-path exports

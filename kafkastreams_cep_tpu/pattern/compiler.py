@@ -38,6 +38,15 @@ def compile_pattern(pattern: Pattern) -> Stages:
     return compiler.compile(pattern)
 
 
+def ensure_stages(pattern_or_stages) -> Stages:
+    """Accept either a Pattern (compiled here, exactly once per call site)
+    or an already-compiled Stages -- the normalization every deployment
+    entry point shares."""
+    if isinstance(pattern_or_stages, Pattern):
+        return compile_pattern(pattern_or_stages)
+    return pattern_or_stages
+
+
 class _Compiler:
     def __init__(self) -> None:
         self._next_id = 0

@@ -1,4 +1,20 @@
 from .aggregates import AggregatesStore, States, UnknownAggregateException
 from .buffer import BufferNode, BufferStore, ReadOnlySharedVersionBuffer, SharedVersionedBuffer
+from .builders import (
+    AbstractStoreBuilder,
+    AggregatesStoreBuilder,
+    BufferStoreBuilder,
+    NFAStoreBuilder,
+    QueryStoreBuilders,
+    changelog_topic,
+    restore_store,
+)
 from .naming import aggregates_store, event_buffer_store, nfa_states_store, normalize_query_name
 from .nfa_store import NFAStates, NFAStore
+from .store import (
+    CachingKeyValueStore,
+    ChangeLoggingKeyValueStore,
+    InMemoryKeyValueStore,
+    StateStore,
+    WrappedStateStore,
+)

@@ -14,24 +14,38 @@ from typing import Any, Dict, Optional, Tuple
 
 
 class AggregatesStore:
-    """Dict-backed fold registers keyed by (key, name, sequence)."""
+    """Fold registers keyed by (key, name, sequence).
 
-    def __init__(self) -> None:
-        self._store: Dict[Tuple[Any, str, int], Any] = {}
+    Dict-backed by default; pass `backing` (a state.store.StateStore) to
+    assemble the reference's change-logging/caching stack around it
+    (state/builders.py, AbstractStoreBuilder.java:52-71)."""
+
+    def __init__(self, backing: Optional[Any] = None) -> None:
+        if backing is None:
+            from .store import InMemoryKeyValueStore
+
+            backing = InMemoryKeyValueStore("aggregates")
+        self._kv = backing
 
     def find(self, key: Any, name: str, sequence: int) -> Optional[Any]:
-        return self._store.get((key, name, sequence))
+        return self._kv.get((key, name, sequence))
 
     def put(self, key: Any, name: str, sequence: int, value: Any) -> None:
-        self._store[(key, name, sequence)] = value
+        self._kv.put((key, name, sequence), value)
 
     def branch(self, key: Any, name: str, from_sequence: int, to_sequence: int) -> None:
         value = self.find(key, name, from_sequence)
         if value is not None:
             self.put(key, name, to_sequence, value)
 
+    def items(self):
+        return self._kv.items()
+
+    def flush(self) -> None:
+        self._kv.flush()
+
     def __len__(self) -> int:
-        return len(self._store)
+        return self._kv.approximate_num_entries()
 
 
 class States:

@@ -148,15 +148,38 @@ class BufferStore(Generic[K, V]):
     (one node pool per key lane, parallel/key_shard.py).
     """
 
-    def __init__(self) -> None:
-        self._buffers: Dict[Any, SharedVersionedBuffer[K, V]] = {}
+    def __init__(self, backing: Optional[Any] = None) -> None:
+        if backing is None:
+            from .store import InMemoryKeyValueStore
+
+            backing = InMemoryKeyValueStore("event-buffer")
+        self._kv = backing
 
     def for_key(self, key: Any) -> SharedVersionedBuffer[K, V]:
-        buffer = self._buffers.get(key)
+        buffer = self._kv.get(key)
         if buffer is None:
             buffer = SharedVersionedBuffer()
-            self._buffers[key] = buffer
+            self._kv.put(key, buffer)
         return buffer
 
+    def persist(self, key: Any) -> None:
+        """Re-put the key's buffer so a change-logging backing captures the
+        in-place mutations the NFA made this record (the reference's store
+        writes each node mutation individually,
+        SharedVersionedBufferStoreImpl.java:117-126; here the changelog
+        granularity is the per-key chain store)."""
+        buffer = self._kv.get(key)
+        if buffer is not None:
+            self._kv.put(key, buffer)
+
+    def items(self):
+        return self._kv.items()
+
+    def set_for_key(self, key: Any, buffer: SharedVersionedBuffer[K, V]) -> None:
+        self._kv.put(key, buffer)
+
+    def flush(self) -> None:
+        self._kv.flush()
+
     def __len__(self) -> int:
-        return sum(len(b) for b in self._buffers.values())
+        return sum(len(b) for _k, b in self._kv.items())

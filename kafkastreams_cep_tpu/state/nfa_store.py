@@ -33,19 +33,33 @@ class NFAStates(Generic[K, V]):
 
 
 class NFAStore(Generic[K, V]):
-    """Dict-backed per-key snapshot store (NFAStoreImpl.java:60-84)."""
+    """Per-key snapshot store (NFAStoreImpl.java:60-84).
 
-    def __init__(self) -> None:
-        self._store: Dict[Any, NFAStates] = {}
+    Dict-backed by default; pass `backing` (a state.store.StateStore, e.g.
+    the change-logging/caching stack assembled by state/builders.py) to get
+    the reference's durability toggles (AbstractStoreBuilder.java:52-71)."""
+
+    def __init__(self, backing: Optional[Any] = None) -> None:
+        if backing is None:
+            from .store import InMemoryKeyValueStore
+
+            backing = InMemoryKeyValueStore("nfa-states")
+        self._kv = backing
 
     def find(self, key: Any) -> Optional[NFAStates]:
-        return self._store.get(key)
+        return self._kv.get(key)
 
     def put(self, key: Any, states: NFAStates) -> None:
-        self._store[key] = states
+        self._kv.put(key, states)
 
     def keys(self):
-        return self._store.keys()
+        return [k for k, _v in self._kv.items()]
+
+    def items(self):
+        return self._kv.items()
+
+    def flush(self) -> None:
+        self._kv.flush()
 
     def __len__(self) -> int:
-        return len(self._store)
+        return self._kv.approximate_num_entries()

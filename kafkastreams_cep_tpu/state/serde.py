@@ -279,8 +279,9 @@ class CheckpointCodec:
         (AggregateKeySerde.java:107-121 analog)."""
         w = _Writer()
         w._buf.write(MAGIC)
-        w.i32(len(store._store))
-        for (key, name, sequence), value in store._store.items():
+        entries = list(store.items())
+        w.i32(len(entries))
+        for (key, name, sequence), value in entries:
             w.blob(self._ser(key))
             w.text(name)
             w.i64(sequence)
@@ -297,7 +298,7 @@ class CheckpointCodec:
             name = r.text()
             sequence = r.i64()
             value = self._de(r.blob())
-            store._store[(key, name, sequence)] = value
+            store.put(key, name, sequence, value)
         return store
 
     # ---------------------------------------------------- query-level stores
@@ -311,12 +312,14 @@ class CheckpointCodec:
         record equivalent (README.md:350-355 store naming scheme)."""
         w = _Writer()
         w._buf.write(MAGIC)
-        w.i32(len(nfa_store._store))
-        for key, snap in nfa_store._store.items():
+        nfa_entries = list(nfa_store.items())
+        w.i32(len(nfa_entries))
+        for key, snap in nfa_entries:
             w.blob(self._ser(key))
             w.blob(self.encode_nfa_states(snap))
-        w.i32(len(buffers._buffers))
-        for key, buffer in buffers._buffers.items():
+        buf_entries = list(buffers.items())
+        w.i32(len(buf_entries))
+        for key, buffer in buf_entries:
             w.blob(self._ser(key))
             w.blob(self.encode_buffer(buffer))
         w.blob(self.encode_aggregates(aggregates))
@@ -331,11 +334,11 @@ class CheckpointCodec:
         nfa_store = NFAStore()
         for _ in range(r.i32()):
             key = self._de(r.blob())
-            nfa_store._store[key] = self.decode_nfa_states(r.blob())
+            nfa_store.put(key, self.decode_nfa_states(r.blob()))
         buffers = BufferStore()
         for _ in range(r.i32()):
             key = self._de(r.blob())
-            buffers._buffers[key] = self.decode_buffer(r.blob())
+            buffers.set_for_key(key, self.decode_buffer(r.blob()))
         aggregates = self.decode_aggregates(r.blob())
         return nfa_store, buffers, aggregates
 

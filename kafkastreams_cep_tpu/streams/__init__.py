@@ -1,3 +1,5 @@
 from .builder import CEPStream, ComplexStreamsBuilder, OutputStream, Record, Topology
+from .driver import LogDriver, produce
+from .log import LogRecord, RecordLog
 from .processor import CEPProcessor
 from .serde import Queried, sequence_to_dict, sequence_to_json

@@ -13,17 +13,14 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Generic, List, Optional, Sequence as Seq, TypeVar, Union
 
-from ..pattern.compiler import compile_pattern
 from ..pattern.pattern import Pattern
-from ..state.aggregates import AggregatesStore
-from ..state.buffer import BufferStore
+from ..state.builders import QueryStoreBuilders
 from ..state.naming import (
     aggregates_store,
     event_buffer_store,
     nfa_states_store,
     normalize_query_name,
 )
-from ..state.nfa_store import NFAStore
 from .processor import CEPProcessor
 from .serde import Queried
 
@@ -58,6 +55,8 @@ class QueryNode(Generic[K, V]):
         pattern: Pattern,
         queried: Optional[Queried],
         runtime: str = "host",
+        log: Optional[Any] = None,
+        app_id: str = "app",
         **device_opts: Any,
     ) -> None:
         self.name = normalize_query_name(name)
@@ -65,10 +64,12 @@ class QueryNode(Generic[K, V]):
         self.queried = queried
         self.runtime = runtime
         self.downstream: List[Callable] = []
+        self.sink_topics: List[str] = []
         if runtime == "tpu":
             from .device_processor import DeviceCEPProcessor
 
             self.stores = {}
+            self.store_builders = None
             self.processor: Any = DeviceCEPProcessor(
                 name,
                 pattern,
@@ -78,14 +79,13 @@ class QueryNode(Generic[K, V]):
             return
         if runtime != "host":
             raise ValueError(f"unknown runtime {runtime!r} (host|tpu)")
-        self.stores: Dict[str, Any] = {
-            nfa_states_store(name): NFAStore(),
-            event_buffer_store(name): BufferStore(),
-            aggregates_store(name): AggregatesStore(),
-        }
+        # Compile once; the builders share the compiled stages with the
+        # processor (QueryStoreBuilders.java:50-56).
+        self.store_builders = QueryStoreBuilders(name, pattern)
+        self.stores: Dict[str, Any] = self.store_builders.build_all(log, app_id)
         self.processor = CEPProcessor(
             name,
-            pattern,
+            self.store_builders.stages,
             nfa_store=self.stores[nfa_states_store(name)],
             buffer=self.stores[event_buffer_store(name)],
             aggregates=self.stores[aggregates_store(name)],
@@ -107,7 +107,15 @@ class CEPStream(Generic[K, V]):
         runtime: str = "host",
         **device_opts: Any,
     ) -> "OutputStream":
-        node = QueryNode(name, pattern, queried, runtime=runtime, **device_opts)
+        node = QueryNode(
+            name,
+            pattern,
+            queried,
+            runtime=runtime,
+            log=self._builder.log,
+            app_id=self._builder.app_id,
+            **device_opts,
+        )
         out = OutputStream(node)
         self._builder._register(self, node, out)
         return out
@@ -124,12 +132,28 @@ class OutputStream:
         self.node.downstream.append(fn)
         return self
 
+    def to(self, topic: str) -> "OutputStream":
+        """Route matches to a sink topic of the builder's RecordLog
+        (the reference's `.through("Matches")` egress,
+        example/.../CEPStockDemo.java:84-99): key pickled, value the golden
+        JSON shape (JsonSequenceSerde.java:26-85)."""
+        self.node.sink_topics.append(topic)
+        return self
+
 
 class ComplexStreamsBuilder:
-    """Framework entry object (ComplexStreamsBuilder.java:61-107)."""
+    """Framework entry object (ComplexStreamsBuilder.java:61-107).
 
-    def __init__(self) -> None:
+    Pass `log` (a streams.log.RecordLog) to enable the durability stack:
+    every query's stores are then change-logged to
+    `<app_id>-<store-name>-changelog` topics, and outputs routed with
+    `OutputStream.to(topic)` land in the log (the reference's sink-topic
+    role)."""
+
+    def __init__(self, log: Optional[Any] = None, app_id: str = "app") -> None:
         self._queries: List[tuple] = []
+        self.log = log
+        self.app_id = app_id
 
     def stream(self, topics: Union[str, Seq[str]]) -> CEPStream:
         if isinstance(topics, str):
@@ -140,15 +164,25 @@ class ComplexStreamsBuilder:
         self._queries.append((stream, node, out))
 
     def build(self) -> "Topology":
-        return Topology(self._queries)
+        return Topology(self._queries, log=self.log)
 
 
 class Topology:
     """The built processing graph, drivable record-by-record."""
 
-    def __init__(self, queries: List[tuple]) -> None:
+    def __init__(self, queries: List[tuple], log: Optional[Any] = None) -> None:
         self.queries = queries
+        self.log = log
         self._offsets: Dict[tuple, int] = {}
+
+    @property
+    def source_topics(self) -> List[str]:
+        seen: List[str] = []
+        for stream, _node, _out in self.queries:
+            for t in stream.topics:
+                if t not in seen:
+                    seen.append(t)
+        return seen
 
     def process(
         self, topic: str, key, value, timestamp: int = 0, partition: int = 0, offset: Optional[int] = None
@@ -180,6 +214,7 @@ class Topology:
                     outputs.append(record)
                     for fn in node.downstream:
                         fn(key, seq)
+                    self._sink(node, record)
         return outputs
 
     def flush(self) -> List[Record]:
@@ -214,4 +249,38 @@ class Topology:
             emitted.append(record)
             for fn in node.downstream:
                 fn(rkey, seq)
+            self._sink(node, record)
         return emitted
+
+    def _sink(self, node: QueryNode, record: Record) -> None:
+        """Write a matched record to the node's sink topics in the log."""
+        if self.log is None or not node.sink_topics:
+            return
+        from ..state.store import default_serializer
+        from .serde import sequence_to_json
+
+        key_bytes = default_serializer(record.key)
+        value_bytes = sequence_to_json(record.value).encode("utf-8")
+        for topic in node.sink_topics:
+            self.log.append(
+                topic, key_bytes, value_bytes, timestamp=record.timestamp
+            )
+
+    def flush_stores(self) -> None:
+        """Flush every query's store stack (pushes cached writes down into
+        the changelog; the reference's commit-interval flush)."""
+        for _stream, node, _out in self.queries:
+            for store in node.stores.values():
+                store.flush()
+
+    def restore_stores(self) -> int:
+        """Replay each store's changelog from the log into the store
+        (the reference's restore-consumer path on rebalance/restart).
+        Returns total changelog records applied."""
+        from ..state.builders import restore_store
+
+        return sum(
+            restore_store(store)
+            for _stream, node, _out in self.queries
+            for store in node.stores.values()
+        )

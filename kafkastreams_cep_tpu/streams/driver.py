@@ -1,0 +1,128 @@
+"""Log pump driver: consume source topics, drive the topology, commit.
+
+The Kafka-Streams-runtime role the reference delegates to its platform
+(reference: the poll/process/commit loop of Kafka Streams' StreamThread
+driving CEPProcessor.java:111-160, with changelog restore on start and
+consumer-group offset commits). Here the transport is the embedded
+`RecordLog` (streams/log.py): the driver restores every query store from
+its changelog topic, resumes from the committed consumer offsets (stored in
+the log's `__consumer_offsets` topic), and pumps records through
+`Topology.process`, committing after each poll.
+
+Records in source topics carry pickled keys/values by default; pass
+`key_deserializer`/`value_deserializer` for custom wire formats.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..state.store import default_deserializer, default_serializer
+from .builder import Topology
+from .log import RecordLog
+
+OFFSETS_TOPIC = "__consumer_offsets"
+
+
+def produce(
+    log: RecordLog,
+    topic: str,
+    key: Any,
+    value: Any,
+    timestamp: int = 0,
+    partition: int = 0,
+) -> int:
+    """Producer-side helper: append one (key, value) record, default serde."""
+    return log.append(
+        topic,
+        default_serializer(key),
+        default_serializer(value),
+        timestamp=timestamp,
+        partition=partition,
+    )
+
+
+class LogDriver:
+    """Drives one topology from a RecordLog: restore, poll, commit."""
+
+    def __init__(
+        self,
+        topology: Topology,
+        log: Optional[RecordLog] = None,
+        group: str = "default",
+        key_deserializer: Callable[[bytes], Any] = default_deserializer,
+        value_deserializer: Callable[[bytes], Any] = default_deserializer,
+        restore: bool = True,
+    ) -> None:
+        self.topology = topology
+        self.log = log if log is not None else topology.log
+        if self.log is None:
+            raise ValueError("LogDriver needs a RecordLog (topology built without one)")
+        self.group = group
+        self.key_de = key_deserializer
+        self.value_de = value_deserializer
+        self._positions: Dict[Tuple[str, int], int] = {}
+        self.restored_records = 0
+        if restore:
+            self.restored_records = self.topology.restore_stores()
+        self._load_committed()
+
+    # ------------------------------------------------------------- offsets
+    def _load_committed(self) -> None:
+        """Latest committed position per (group, topic, partition)."""
+        for rec in self.log.read(OFFSETS_TOPIC):
+            if rec.key is None or rec.value is None:
+                continue
+            group, topic, partition = default_deserializer(rec.key)
+            if group != self.group:
+                continue
+            self._positions[(topic, partition)] = default_deserializer(rec.value)
+
+    def commit(self) -> None:
+        """Durably record consumer positions (and flush store caches so the
+        changelog is consistent with the committed offsets -- the reference
+        commits offsets and flushes stores together at the commit interval)."""
+        self.topology.flush_stores()
+        for (topic, partition), pos in self._positions.items():
+            self.log.append(
+                OFFSETS_TOPIC,
+                default_serializer((self.group, topic, partition)),
+                default_serializer(pos),
+            )
+        self.log.flush()
+
+    def position(self, topic: str, partition: int = 0) -> int:
+        return self._positions.get((topic, partition), 0)
+
+    # ---------------------------------------------------------------- poll
+    def poll(self, max_records: Optional[int] = None, commit: bool = True) -> int:
+        """Consume available records from every source topic, in offset
+        order per partition; returns how many were processed."""
+        processed = 0
+        budget = max_records
+        for topic in self.topology.source_topics:
+            partitions = self.log.partitions(topic) or [0]
+            for partition in partitions:
+                start = self._positions.get((topic, partition), 0)
+                records = self.log.read(topic, partition, start, budget)
+                for rec in records:
+                    self.topology.process(
+                        topic,
+                        self.key_de(rec.key) if rec.key is not None else None,
+                        self.value_de(rec.value) if rec.value is not None else None,
+                        timestamp=rec.timestamp,
+                        partition=partition,
+                        offset=rec.offset,
+                    )
+                    processed += 1
+                if records:
+                    self._positions[(topic, partition)] = records[-1].offset + 1
+                if budget is not None:
+                    budget -= len(records)
+                    if budget <= 0:
+                        break
+            if budget is not None and budget <= 0:
+                break
+        self.topology.flush()  # flush device micro-batches
+        if commit and processed:
+            self.commit()
+        return processed

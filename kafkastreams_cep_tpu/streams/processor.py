@@ -18,8 +18,7 @@ from typing import Any, Callable, Generic, List, Optional, Tuple, TypeVar
 from ..core.event import Event
 from ..core.sequence import Sequence
 from ..nfa.nfa import NFA, initial_computation_stage
-from ..pattern.compiler import compile_pattern
-from ..pattern.pattern import Pattern
+from ..pattern.compiler import ensure_stages
 from ..pattern.stages import Stages
 from ..state.aggregates import AggregatesStore
 from ..state.buffer import BufferStore
@@ -42,10 +41,7 @@ class CEPProcessor(Generic[K, V]):
         aggregates: Optional[AggregatesStore] = None,
         strict_windows: bool = False,
     ) -> None:
-        if isinstance(pattern_or_stages, Pattern):
-            self.stages: Stages = compile_pattern(pattern_or_stages)
-        else:
-            self.stages = pattern_or_stages
+        self.stages: Stages = ensure_stages(pattern_or_stages)
         self.query_name = normalize_query_name(query_name)
         self.nfa_store = nfa_store if nfa_store is not None else NFAStore()
         self.buffer = buffer if buffer is not None else BufferStore()
@@ -104,6 +100,10 @@ class CEPProcessor(Generic[K, V]):
         self.nfa_store.put(
             key, NFAStates(list(nfa.computation_stages), nfa.runs, offsets)
         )
+        # Re-put the key's buffer so a change-logging backing captures this
+        # record's in-place chain mutations (CEPProcessor.java:144-147
+        # persists all three stores every record).
+        self.buffer.persist(key)
         return sequences
 
     # --------------------------------------------------------- checkpointing
