@@ -1,0 +1,81 @@
+"""Native runtime components, built on demand.
+
+The reference ships no native code (SURVEY.md §2.8: its near-native layer is
+RocksDB via Kafka Streams); this framework's native layer is the XLA/Pallas
+kernel set plus this C++ ingest packer (packer.cc), which removes the
+per-(event, field) interpreter walk from the micro-batch packing hot path.
+
+`load_packer()` returns the extension module, compiling it with g++ on
+first use (no pybind11 in the image; plain CPython C API against the
+running interpreter's headers). Any failure -- no compiler, no headers,
+sandboxed filesystem -- degrades silently to the pure-Python packer, which
+remains the semantic reference (ops/schema.py, parallel/batched.py).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+from typing import Any, Optional
+
+_packer: Any = None
+_tried = False
+
+
+def _build_dir() -> str:
+    return os.path.join(os.path.dirname(__file__), "_build")
+
+
+def _so_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_build_dir(), f"_packer{suffix}")
+
+
+def build_packer(force: bool = False) -> Optional[str]:
+    """Compile packer.cc into the package-local _build dir; returns the .so
+    path or None on failure."""
+    src = os.path.join(os.path.dirname(__file__), "packer.cc")
+    out = _so_path()
+    if not force and os.path.exists(out) and (
+        os.path.getmtime(out) >= os.path.getmtime(src)
+    ):
+        return out
+    include = sysconfig.get_paths()["include"]
+    os.makedirs(_build_dir(), exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}", src, "-o", out,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    return out
+
+
+def load_packer() -> Any:
+    """The compiled _packer module, or None when unavailable."""
+    global _packer, _tried
+    if _tried:
+        return _packer
+    _tried = True
+    if os.environ.get("KCT_NO_NATIVE"):
+        return None
+    so = build_packer()
+    if so is None:
+        return None
+    try:
+        # The name must match the extension's PyInit__packer symbol.
+        spec = importlib.util.spec_from_file_location("_packer", so)
+        assert spec is not None and spec.loader is not None
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _packer = mod
+    except Exception:
+        _packer = None
+    return _packer

@@ -171,6 +171,39 @@ def _excl_cumsum(mask: jnp.ndarray) -> jnp.ndarray:
     return c - mask.astype(jnp.int32)
 
 
+
+
+def _nth_set_select(mask: jnp.ndarray, n_out: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Indices of the first `n_out` set bits of a [R, S] mask in row-major
+    order, without a sort.
+
+    TPU sorts lower to custom-calls whose operands get staged through
+    scratch space with multi-ms layout-conversion copies per scan step
+    (profiled: the former stable-argsort compaction dominated step time).
+    This two-level selection is pure fused arithmetic: per-row inclusive
+    cumsums locate the j-th set bit by (a) a row-offset comparison matrix
+    [n_out, R] and (b) an equality hit on the gathered row [n_out, S].
+
+    Returns (flat row-major indices [n_out] int32, valid [n_out] bool).
+    vmap-safe (no data-dependent shapes)."""
+    Rr, S = mask.shape
+    cum = jnp.cumsum(mask.astype(jnp.int32), axis=1)     # [R, S] inclusive
+    c = cum[:, -1]                                       # per-row set count
+    off = jnp.cumsum(c) - c                              # exclusive row offsets
+    total = off[-1] + c[-1]
+    j = jnp.arange(n_out, dtype=jnp.int32)
+    # Last row whose offset <= j; empty rows share the offset of their
+    # successor, so the count lands on the row actually holding bit j.
+    rj = (jnp.sum(off[None, :] <= j[:, None], axis=1) - 1).astype(jnp.int32)
+    p = j - off[rj]                                      # rank within row
+    cr = cum[rj]                                         # [n_out, S]
+    mr = mask[rj]
+    hit = mr & (cr == (p[:, None] + 1))                  # exactly one per row
+    s = jnp.argmax(hit, axis=1).astype(jnp.int32)
+    ok = j < total
+    return jnp.where(ok, rj * S + s, 0), ok
+
+
 def build_step(
     query: CompiledQuery, config: EngineConfig, debug: bool = False
 ) -> Callable[..., Tuple[Dict[str, jnp.ndarray], Any]]:
@@ -192,30 +225,55 @@ def build_step(
     M_STEP = config.matches_per_step
     L = query.max_depth
     P = query.n_preds
-    SLOTS = 4 * L
+    # 3 slots per level: consume and ignore emissions are mutually exclusive
+    # per (lane, level) -- ignore_emit = ig_m & ~branch_m and branch_m is set
+    # whenever both fire (NFA.java:392-397) -- so they share one slot; the
+    # upward clone and begin-re-add slots can both fire for a begin lane and
+    # stay separate.
+    SLOTS = 3 * L
     P_CAP = config.nodes_per_step if config.nodes_per_step > 0 else R * L
 
-    # Device-constant stage tables.
-    t_consume_op = jnp.asarray(query.consume_op)
-    t_consume_pred = jnp.asarray(query.consume_pred)
-    t_consume_target = jnp.asarray(query.consume_target)
-    t_ignore_pred = jnp.asarray(query.ignore_pred)
-    t_proceed_kind = jnp.asarray(query.proceed_kind)
-    t_proceed_pred = jnp.asarray(query.proceed_pred)
-    t_proceed_target = jnp.asarray(query.proceed_target)
+    # Stage tables as HOST numpy constants: every per-lane lookup goes
+    # through a one-hot contraction against the lane's stage id instead of a
+    # dynamic gather. TPU lowers gather-by-computed-index into multi-pass
+    # fusions over padded minor dims (profiled at ~0.8 ms per gather per
+    # step); the one-hot forms fuse into neighboring elementwise work.
+    n_consume_op = np.asarray(query.consume_op)
+    n_consume_pred = np.asarray(query.consume_pred)
+    n_consume_target = np.asarray(query.consume_target)
+    n_ignore_pred = np.asarray(query.ignore_pred)
+    n_proceed_kind = np.asarray(query.proceed_kind)
+    n_proceed_pred = np.asarray(query.proceed_pred)
+    n_proceed_target = np.asarray(query.proceed_target)
     # i64 window clamped into i32: rebased timestamps are i32, so a clamped
     # huge window compares identically to "no expiry".
-    t_window = jnp.asarray(
-        np.where(query.window_ms < 0, -1, np.minimum(query.window_ms, _I32_MAX - 1)).astype(
-            np.int32
-        )
-    )
-    t_name_id = jnp.asarray(query.name_id)
-    t_pure_name = jnp.asarray(query.pure_name_id)
-    t_is_begin = jnp.asarray(query.is_begin)
-    t_is_final = jnp.asarray(query.is_final)
-    t_is_fwd = jnp.asarray(query.is_fwd)
-    t_fwd_final = jnp.asarray(query.fwd_final)
+    n_window = np.where(
+        query.window_ms < 0, -1, np.minimum(query.window_ms, _I32_MAX - 1)
+    ).astype(np.int32)
+    n_name_id = np.asarray(query.name_id)
+    n_pure_name = np.asarray(query.pure_name_id)
+    n_is_begin = np.asarray(query.is_begin)
+    n_is_final = np.asarray(query.is_final)
+    n_is_fwd = np.asarray(query.is_fwd)
+    n_fwd_final = np.asarray(query.fwd_final)
+    N_ST = len(n_consume_op)
+    # Static table compositions (evaluated once at trace time).
+    n_pure_of_ptgt = n_pure_name[n_proceed_target.clip(0)]
+    n_isfin_of_ctgt = n_is_final[n_consume_target.clip(0)] & (n_consume_target >= 0)
+
+    ar_st = jnp.arange(N_ST, dtype=jnp.int32)
+
+    def onehot(ids: jnp.ndarray) -> jnp.ndarray:
+        """[R] stage ids -> [R, N_ST] one-hot (all-false for id -1)."""
+        return ids[:, None] == ar_st[None, :]
+
+    def lut_i(oh: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
+        return jnp.sum(
+            jnp.where(oh, jnp.asarray(table, jnp.int32)[None, :], 0), axis=1
+        ).astype(jnp.int32)
+
+    def lut_b(oh: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
+        return jnp.any(oh & jnp.asarray(table, bool)[None, :], axis=1)
 
     stateful = [bool(f) for f in query.pred_stateful]
 
@@ -262,33 +320,44 @@ def build_step(
             cols.append(jnp.broadcast_to(jnp.asarray(v, bool), (R,)))
         pred_vals = jnp.stack(cols, axis=1)
 
-        def pval(pid: jnp.ndarray) -> jnp.ndarray:
-            got = jnp.take_along_axis(pred_vals, pid.clip(0)[:, None], axis=1)[:, 0]
-            return got & (pid >= 0)
+        def lut_pred(oh: jnp.ndarray, pid_table: np.ndarray) -> jnp.ndarray:
+            """Per-lane predicate mask for a stage->pid table: static column
+            permutation of pred_vals + one-hot contraction (no gather)."""
+            cols_by_stage = pred_vals[:, pid_table.clip(0)]  # [R, N_ST], static
+            valid = jnp.asarray(pid_table >= 0)[None, :]
+            return jnp.any(oh & cols_by_stage & valid, axis=1)
 
         # -- window expiry (NFA.java:183-184; begin states never expire, and
         # synthesized epsilon stages carry no window, Stage.java:247-251;
         # strict_windows inherits the target's window instead -- see
         # EngineConfig.strict_windows) -----------------------------------
-        root_begin = t_is_begin[src]
+        oh_src = onehot(src)
+        oh_eps = onehot(eps)  # all-false rows where eps == -1
+        root_begin = lut_b(oh_src, n_is_begin)
+        w_src = lut_i(oh_src, n_window)
         if config.strict_windows:
-            w_eps = t_window[eps.clip(0)]
-            w_eps = jnp.where(w_eps >= 0, w_eps, t_window[src])
-            eff_window = jnp.where(eps >= 0, w_eps, t_window[src])
+            w_eps = lut_i(oh_eps, n_window)
+            w_eps = jnp.where(w_eps >= 0, w_eps, w_src)
+            eff_window = jnp.where(eps >= 0, w_eps, w_src)
             expired = (
                 active & (lane_ts >= 0) & (eff_window >= 0)
                 & ((ev_ts - lane_ts) > eff_window)
             )
         else:
-            eff_window = jnp.where(eps >= 0, -1, t_window[src])
+            eff_window = jnp.where(eps >= 0, -1, w_src)
             expired = (
                 active & ~root_begin & (eff_window >= 0)
                 & ((ev_ts - lane_ts) > eff_window)
             )
         active = active & ~expired
 
-        root_fwd = (eps >= 0) | t_is_fwd[src]
+        root_fwd = (eps >= 0) | lut_b(oh_src, n_is_fwd)
         start_ts = jnp.where(root_begin, ev_ts, lane_ts)
+        # Queue-item match flag for slots that keep the state's (src, eps)
+        # identity (ignore / branch-root-copy / begin-root re-add slots).
+        state_match = ((eps >= 0) & lut_b(oh_eps, n_is_final)) | (
+            (eps < 0) & lut_b(oh_src, n_fwd_final)
+        )
 
         # ==== downward pass: unrolled epsilon descent =======================
         alive = active
@@ -303,25 +372,32 @@ def build_step(
 
         levels: List[Dict[str, jnp.ndarray]] = []
         for _l in range(L):
-            c_op = jnp.where(is_eps, OP_NONE, t_consume_op[cs])
-            c_m = alive & (c_op != OP_NONE) & pval(
-                jnp.where(is_eps, -1, t_consume_pred[cs])
+            oh = oh_src if _l == 0 else onehot(cs)
+            c_op = jnp.where(is_eps, OP_NONE, lut_i(oh, n_consume_op))
+            c_m = (
+                alive & ~is_eps & (c_op != OP_NONE)
+                & lut_pred(oh, n_consume_pred)
             )
             take_m = c_m & (c_op == OP_TAKE)
             begin_m = c_m & (c_op == OP_BEGIN)
-            ig_m = alive & ~is_eps & pval(t_ignore_pred[cs])
-            pk = jnp.where(is_eps, PR_PROCEED, t_proceed_kind[cs])
-            ptgt = jnp.where(is_eps, ceps, t_proceed_target[cs])
-            p_m = alive & (pk != PR_NONE) & (is_eps | pval(t_proceed_pred[cs]))
+            ig_m = alive & ~is_eps & lut_pred(oh, n_ignore_pred)
+            pk = jnp.where(is_eps, PR_PROCEED, lut_i(oh, n_proceed_kind))
+            ptgt = jnp.where(is_eps, ceps, lut_i(oh, n_proceed_target))
+            p_m = alive & (pk != PR_NONE) & (is_eps | lut_pred(oh, n_proceed_pred))
             # Branching combos (NFA.java:392-397): PROCEED+TAKE, IGNORE+TAKE,
             # IGNORE+BEGIN, IGNORE+PROCEED (SKIP_PROCEED does not count).
             p_strict = p_m & (pk == PR_PROCEED)
             branch_m = (p_strict & take_m) | (ig_m & (c_m | p_strict))
 
             ptgt_c = ptgt.clip(0)
+            # pure_name[ptgt]: statically composed for the table path; the
+            # level-0 epsilon path reads through the eps one-hot instead.
+            pure_tgt = lut_i(oh, n_pure_of_ptgt)
+            if _l == 0:
+                pure_tgt = jnp.where(is_eps, lut_i(oh_eps, n_pure_name), pure_tgt)
             fwd_next = (
                 p_m
-                & (t_pure_name[ptgt_c] != t_pure_name[cs])
+                & (pure_tgt != lut_i(oh, n_pure_name))
                 & ~br
                 & ~ig
             )
@@ -331,7 +407,7 @@ def build_step(
                     alive=alive, cs=cs, is_eps=is_eps, ver=ver, vlen=vlen,
                     br=br, ig=ig, ps=ps, c_m=c_m, take_m=take_m,
                     begin_m=begin_m, ig_m=ig_m, p_m=p_m, pk=pk, ptgt=ptgt_c,
-                    branch_m=branch_m,
+                    branch_m=branch_m, oh=oh,
                 )
             )
 
@@ -383,9 +459,11 @@ def build_step(
         # post-advance GC. With P_CAP < R*L one stable argsort compacts the
         # consumed slots to the front; overflow is counted in node_drops.
         put_flat = jnp.stack([v["c_m"] for v in levels], axis=1).reshape(-1)  # [R*L]
-        cs_mat = jnp.stack([v["cs"] for v in levels], axis=1)  # [R, L]
+        name_mat = jnp.stack(
+            [lut_i(v["oh"], n_name_id) for v in levels], axis=1
+        )  # [R, L]
         v_event = jnp.where(put_flat, gidx, -1).astype(jnp.int32)
-        v_name = jnp.where(put_flat, t_name_id[cs_mat.reshape(-1)], -1)
+        v_name = jnp.where(put_flat, name_mat.reshape(-1), -1)
         v_pred = jnp.where(put_flat, jnp.repeat(lane_node, L), -1)
         base = B + t * P_CAP
         if P_CAP >= R * L:
@@ -397,10 +475,10 @@ def build_step(
             n_put = jnp.sum(put_flat).astype(jnp.int32)
             put_ok = put_flat & (rank < P_CAP)
             put_idx = jnp.where(put_ok, base + rank, -1).reshape(R, L)
-            porder = jnp.argsort(~put_flat, stable=True)
-            w_event = v_event[porder][:P_CAP]
-            w_name = v_name[porder][:P_CAP]
-            w_pred = v_pred[porder][:P_CAP]
+            psel, pok = _nth_set_select(put_flat.reshape(R, L), P_CAP)
+            w_event = jnp.where(pok, v_event[psel], -1)
+            w_name = jnp.where(pok, v_name[psel], -1)
+            w_pred = jnp.where(pok, v_pred[psel], -1)
             step_node_drops = jnp.maximum(n_put - P_CAP, 0).astype(jnp.int32)
 
         # ==== upward pass: clones / begin-re-adds (NFA.java:289-338) ========
@@ -439,46 +517,47 @@ def build_step(
         slot_node, slot_ts, slot_br, slot_ig = [], [], [], []
         slot_newseq = []       # allocates a fresh run id
         slot_regs, slot_regs_set = [], []
+        slot_match = []        # forwarding-to-final flag per slot
 
         for l in range(L):
             v = levels[l]
-            # consume emission: TAKE -> epsilon(self, self); BEGIN ->
-            # epsilon(self, target) (NFA.java:238-271).
-            c_eps = jnp.where(v["take_m"], v["cs"], t_consume_target[v["cs"]])
-            slot_occ.append(v["c_m"])
-            slot_src.append(v["cs"])
-            slot_eps.append(c_eps)
+            # Merged downward slot: consume emission (TAKE -> epsilon(self,
+            # self); BEGIN -> epsilon(self, target), NFA.java:238-271) or
+            # ignore emission (keeps the computation as-is with ignored=True:
+            # ROOT stage identity at any descent depth, NFA.java:272-285
+            # re-adds the queue item's own -- possibly synthesized-epsilon --
+            # stage, never the descended stage). At most one of the two
+            # fires per (lane, level) -- when both predicates pass, branch_m
+            # is set and the ignore routes through the clone slot instead
+            # (NFA.java:392-397) -- and DFS order (consume before ignore)
+            # is preserved trivially with a single occupant.
+            c_eps = jnp.where(v["take_m"], v["cs"], lut_i(v["oh"], n_consume_target))
+            ign = up[l]["ignore_emit"]
+            c_m = v["c_m"]
+            slot_occ.append(c_m | ign)
+            slot_src.append(jnp.where(c_m, v["cs"], src))
+            slot_eps.append(jnp.where(c_m, c_eps, eps))
             slot_ver.append(v["ver"])
             slot_vlen.append(v["vlen"])
             slot_seq.append(lane_seq)
-            slot_node.append(put_idx[:, l].astype(jnp.int32))
-            slot_ts.append(start_ts)
+            slot_node.append(
+                jnp.where(c_m, put_idx[:, l].astype(jnp.int32), lane_node)
+            )
+            slot_ts.append(jnp.where(c_m, start_ts, lane_ts))
             slot_br.append(false_b)
-            slot_ig.append(false_b)
+            slot_ig.append(~c_m)
             slot_newseq.append(false_b)
             slot_regs.append(final_regs)
             slot_regs_set.append(final_set)
-
-            # ignore emission keeps the computation as-is with ignored=True:
-            # ROOT stage identity at any descent depth
-            # (NFA.java:272-285 re-adds ctx.getComputationStage().getStage(),
-            # i.e. the queue item's own -- possibly synthesized-epsilon --
-            # stage, never the descended stage; rewriting identity here both
-            # skips the epsilon hop and re-attaches the descended stage's
-            # window to a run the oracle never expires).
-            slot_occ.append(up[l]["ignore_emit"])
-            slot_src.append(src)
-            slot_eps.append(eps)
-            slot_ver.append(v["ver"])
-            slot_vlen.append(v["vlen"])
-            slot_seq.append(lane_seq)
-            slot_node.append(lane_node)
-            slot_ts.append(lane_ts)
-            slot_br.append(false_b)
-            slot_ig.append(jnp.ones(R, bool))
-            slot_newseq.append(false_b)
-            slot_regs.append(final_regs)
-            slot_regs_set.append(final_set)
+            # consume: new eps = c_eps (TAKE -> self, BEGIN -> target), both
+            # >= 0, so the match test is is_final[c_eps] -- statically
+            # composed per stage; ignore keeps the queue item's identity.
+            match_consume = jnp.where(
+                v["take_m"],
+                lut_b(v["oh"], n_is_final),
+                lut_b(v["oh"], n_isfin_of_ctgt),
+            )
+            slot_match.append(jnp.where(c_m, match_consume, state_match))
 
         for l in reversed(range(L)):
             v = levels[l]
@@ -490,7 +569,7 @@ def build_step(
             # nfa/nfa.py:286-291).
             has_ps = v["ps"] >= 0
             cl_src = jnp.where(has_ps, v["ps"], v["cs"])
-            ps_begin = jnp.where(has_ps, t_is_begin[v["ps"].clip(0)], True)
+            ps_begin = jnp.where(has_ps, lut_b(onehot(v["ps"]), n_is_begin), True)
             off = jnp.where(ps_begin & (v["vlen"] >= 2), 2, 1).astype(jnp.int32)
             cl_ver = add_run(v["ver"], v["vlen"], off)
             cl_node = jnp.where(v["ig_m"], lane_node, put_idx[:, l].astype(jnp.int32))
@@ -512,6 +591,10 @@ def build_step(
             cr, cr_set = clone_regs[l]
             slot_regs.append(jnp.where(m_clone[:, None], cr, final_regs))
             slot_regs_set.append(jnp.where(m_clone[:, None], cr_set, final_set))
+            # clone: eps = current (descended) stage; root copy keeps state.
+            slot_match.append(
+                jnp.where(m_clone, lut_b(v["oh"], n_is_final), state_match)
+            )
 
             # begin re-add: fresh run on consume else the root itself
             # (NFA.java:323-338).
@@ -533,6 +616,8 @@ def build_step(
             slot_regs_set.append(
                 jnp.where(m_fresh[:, None], jnp.zeros_like(final_set), final_set)
             )
+            # re-add keeps the root's (src, eps) identity in both cases.
+            slot_match.append(state_match)
 
         occ = jnp.stack(slot_occ, axis=1)              # [R, SLOTS]
         o_src = jnp.stack(slot_src, axis=1)
@@ -557,28 +642,22 @@ def build_step(
         ).reshape(R, SLOTS).astype(jnp.int32)
         new_runs = state["runs"] + jnp.sum(newseq_flat).astype(jnp.int32)
 
-        # ==== match extraction (forwarding-to-final, NFA.java:148-158) ======
-        # Up to M_STEP match ids leave as scan outputs, compacted to the
-        # front in emission order (one small stable argsort per step).
-        is_match = occ & (
-            ((o_eps >= 0) & t_is_final[o_eps.clip(0)])
-            | ((o_eps < 0) & t_fwd_final[o_src.clip(0)])
-        )
-        match_flat = is_match.reshape(-1)
-        n_match = jnp.sum(match_flat).astype(jnp.int32)
-        morder = jnp.argsort(~match_flat, stable=True)
-        w_match = jnp.where(match_flat, o_node.reshape(-1), -1)[morder][:M_STEP]
+        # ==== match extraction + lane compaction (sortless) =================
+        # Matches (forwarding-to-final, NFA.java:148-158) and surviving
+        # queue slots are each selected by the two-level set-bit selector
+        # over [R, SLOTS] masks in emission (row-major DFS) order -- no
+        # sort custom-calls and no stacked-table gathers on the per-event
+        # path (per-slot match flags were computed level-locally above).
+        is_match = occ & jnp.stack(slot_match, axis=1)
+        keep_2d = occ & ~is_match
+        n_match = jnp.sum(is_match).astype(jnp.int32)
+        n_keep = jnp.sum(keep_2d).astype(jnp.int32)
+
+        msel, mok = _nth_set_select(is_match, M_STEP)
+        w_match = jnp.where(mok, o_node.reshape(-1)[msel], -1)
         step_match_drops = jnp.maximum(n_match - M_STEP, 0)
 
-        # ==== lane compaction (new queue in emission order) =================
-        # One stable argsort brings kept slots to the front in emission
-        # order; every lane field is then a plain gather of the first R --
-        # no scatters anywhere on the per-event path.
-        keep = (occ & ~is_match).reshape(-1)
-        n_keep = jnp.sum(keep).astype(jnp.int32)
-        korder = jnp.argsort(~keep, stable=True)
-        sel = korder[:R]
-        lane_ok = jnp.arange(R) < n_keep
+        sel, lane_ok = _nth_set_select(keep_2d, R)
         lane_drop_count = jnp.maximum(n_keep - R, 0)
 
         def compact(flat_vals, fill, extra_dims=()):
@@ -729,6 +808,9 @@ def build_post(query: CompiledQuery, config: EngineConfig):
         rank = _excl_cumsum(marked)
         remap = jnp.where(marked & (rank < B), rank, -1).astype(jnp.int32)
         remap_full = jnp.concatenate([remap, jnp.full(1, -1, jnp.int32)])
+        # One stable argsort per *advance* (not per event step) is cheaper
+        # here than the two-level selector: the [B, BW/128] hit matrices it
+        # needs outweigh a single sort at this width.
         sel = jnp.argsort(~marked, stable=True)[:B]
         ok = jnp.arange(B) < jnp.minimum(n_keep, B)
         combined_event = jnp.concatenate([pool["node_event"], w_event])

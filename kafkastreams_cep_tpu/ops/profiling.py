@@ -1,0 +1,112 @@
+"""Observability: per-batch timing, match-emit latency histogram, profiler.
+
+SURVEY.md §5.1/§5.5: the reference exposes only Kafka Streams' generic
+metrics; the framework-owned metrics here are the per-batch engine timings
+(dispatch vs drain wall), a match-emit latency histogram (the BASELINE.md
+metric: time from `advance` dispatch to the drain that surfaced the match),
+and the engine counter totals (ops/engine.py state counters).
+
+`device_trace` wraps `jax.profiler.trace` so a user can capture an xplane
+trace of the advance/GC programs without importing jax.profiler themselves.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class BatchTimings:
+    """Ring buffer of per-batch timing records with percentile summaries.
+
+    Semantics under the async dispatch model (PERF.md): `advance_s` is the
+    host dispatch wall (sync-free advances pipeline, so this is NOT device
+    time); `drain_s` spans the blocking drain -- the only sync point -- so
+    `advance dispatch -> drain return` is the match-emit latency an outside
+    observer experiences.
+    """
+
+    def __init__(self, capacity: int = 1024) -> None:
+        self.capacity = capacity
+        self._records: List[Dict[str, float]] = []
+        self._t_first_undrained: Optional[float] = None
+
+    # ------------------------------------------------------------- recording
+    def record_advance(self, seconds: float, slots: int) -> None:
+        """`slots` is the dispatched [T, K] slot count (padding included) --
+        known host-side without a device sync; exact event totals live in
+        the engine's n_events counter."""
+        now = time.perf_counter()
+        if self._t_first_undrained is None:
+            self._t_first_undrained = now - seconds
+        self._push(dict(kind=0.0, seconds=seconds, slots=float(slots)))
+
+    def record_drain(self, seconds: float, matches: int) -> None:
+        now = time.perf_counter()
+        emit_latency = (
+            now - self._t_first_undrained
+            if self._t_first_undrained is not None
+            else seconds
+        )
+        self._t_first_undrained = None
+        self._push(
+            dict(
+                kind=1.0, seconds=seconds, matches=float(matches),
+                emit_latency=emit_latency,
+            )
+        )
+
+    def _push(self, rec: Dict[str, float]) -> None:
+        self._records.append(rec)
+        if len(self._records) > self.capacity:
+            del self._records[: len(self._records) - self.capacity]
+
+    # ------------------------------------------------------------ summaries
+    def emit_latencies_ms(self) -> np.ndarray:
+        return np.asarray(
+            [r["emit_latency"] * 1e3 for r in self._records if r["kind"] == 1.0]
+        )
+
+    def histogram(self, bins: Optional[List[float]] = None) -> Dict[str, Any]:
+        """Match-emit latency histogram (ms buckets)."""
+        lat = self.emit_latencies_ms()
+        if bins is None:
+            bins = [1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1000.0, 5000.0]
+        counts, edges = np.histogram(lat, bins=[0.0] + bins + [np.inf])
+        return {
+            "edges_ms": [0.0] + list(bins) + [float("inf")],
+            "counts": [int(c) for c in counts],
+            "n": int(lat.size),
+        }
+
+    def summary(self) -> Dict[str, float]:
+        lat = self.emit_latencies_ms()
+        adv = np.asarray(
+            [r["seconds"] for r in self._records if r["kind"] == 0.0]
+        )
+        slots = sum(r.get("slots", 0.0) for r in self._records if r["kind"] == 0.0)
+        matches = sum(r.get("matches", 0.0) for r in self._records if r["kind"] == 1.0)
+        out: Dict[str, float] = {
+            "batches": float(adv.size),
+            "drains": float(lat.size),
+            "slots": float(slots),
+            "matches": float(matches),
+        }
+        if adv.size:
+            out["advance_dispatch_ms_mean"] = float(adv.mean() * 1e3)
+        if lat.size:
+            out["emit_latency_ms_p50"] = float(np.percentile(lat, 50))
+            out["emit_latency_ms_p99"] = float(np.percentile(lat, 99))
+            out["emit_latency_ms_max"] = float(lat.max())
+        return out
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture a device profile (xplane) of the enclosed block."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -40,6 +40,11 @@ from .key_shard import (
     shard_xs,
 )
 
+#: Rebase margin: keys first seen after the base is fixed may start up to
+#: this much earlier and still rebase non-negative (~17 minutes; i32
+#: timestamps span ~24 days either side of the base).
+TS_REBASE_MARGIN_MS = 1 << 20
+
 
 class BatchedDeviceNFA:
     """K independent per-key NFAs advanced as one [T, K] device program.
@@ -101,6 +106,11 @@ class BatchedDeviceNFA:
         self._ts_base: Optional[int] = None
         self._batches = 0
         self._stats_fn = None
+        from ..ops.profiling import BatchTimings
+
+        #: Per-batch dispatch/drain timings + match-emit latency histogram
+        #: (SURVEY.md §5.5; semantics in ops/profiling.py).
+        self.timings = BatchTimings()
 
     # ------------------------------------------------------------------ API
     def add_keys(self, new_keys: Seq[Any]) -> None:
@@ -168,19 +178,27 @@ class BatchedDeviceNFA:
         """
         lists: List[Seq[Event]] = [() for _ in range(self.K_padded)]
         T = 0
-        first: Optional[Event] = None
+        min_first: Optional[int] = None
         for key, evs in events_by_key.items():
             idx = self.key_index.get(key)
             if idx is None:
                 raise KeyError(f"unknown key {key!r} (fixed at construction)")
             lists[idx] = evs
             T = max(T, len(evs))
-            if first is None and evs:
-                first = evs[0]
-        if T == 0 or first is None:
+            if evs:
+                ts0 = int(evs[0].timestamp)
+                min_first = ts0 if min_first is None else min(min_first, ts0)
+        if T == 0 or min_first is None:
             raise ValueError("empty batch")
         if self._ts_base is None:
-            self._ts_base = int(first.timestamp)
+            # Shared rebase across ALL keys: take the min first-timestamp in
+            # this batch minus a margin, so a key whose stream starts
+            # (boundedly) earlier than the first-seen key still rebases to a
+            # non-negative i32 -- negative rebased times collide with the
+            # engine's -1 "unstarted" sentinel and silently disable window
+            # expiry for those runs (found by the multikey differential
+            # harness, seeds 8/10).
+            self._ts_base = min_first - TS_REBASE_MARGIN_MS
 
         K = self.K_padded
         schema = self.query.schema
@@ -193,25 +211,63 @@ class BatchedDeviceNFA:
         valid = np.zeros((T, K), bool)
         gidx = np.full((T, K), -1, np.int32)
 
-        for k, evs in enumerate(lists):
-            if not evs:
-                continue
-            n = len(evs)
-            key_cols = schema.pack(
-                [e.value for e in evs],
-                [e.timestamp for e in evs],
-                topics=[e.topic for e in evs],
-                ts_base=self._ts_base,
+        native = self._native_packer()
+        if native is not None:
+            # One C call packs every (lane, event, field): extraction,
+            # tokenization, ts rebase, validity, gidx and registry update
+            # (native/packer.cc; the Python loop below stays the semantic
+            # reference and the fallback).
+            field_names = tuple(schema.fields.keys())
+            is_float = tuple(
+                np.dtype(dt) == np.float32 for dt in schema.fields.values()
             )
-            for name, arr in key_cols.items():
-                cols[name][:n, k] = arr
-            ids = np.arange(self._next_gidx, self._next_gidx + n, dtype=np.int32)
-            gidx[:n, k] = ids
-            self._next_gidx += n
-            for g, e in zip(ids, evs):
-                self._events[int(g)] = e
-            valid[:n, k] = True
+            self._next_gidx = native.pack_batch(
+                [list(evs) for evs in lists],
+                field_names,
+                is_float,
+                schema._vocab,
+                schema._rev_vocab,
+                schema._topic_vocab,
+                int(self._ts_base),
+                tuple(cols[f"f:{n}"] for n in field_names),
+                cols["ts"],
+                cols["topic"],
+                valid,
+                gidx,
+                int(self._next_gidx),
+                self._events,
+            )
+        else:
+            for k, evs in enumerate(lists):
+                if not evs:
+                    continue
+                n = len(evs)
+                key_cols = schema.pack(
+                    [e.value for e in evs],
+                    [e.timestamp for e in evs],
+                    topics=[e.topic for e in evs],
+                    ts_base=self._ts_base,
+                )
+                for name, arr in key_cols.items():
+                    cols[name][:n, k] = arr
+                ids = np.arange(self._next_gidx, self._next_gidx + n, dtype=np.int32)
+                gidx[:n, k] = ids
+                self._next_gidx += n
+                for g, e in zip(ids, evs):
+                    self._events[int(g)] = e
+                valid[:n, k] = True
 
+        # Complete rebase-underflow guard: covers out-of-order events deep
+        # inside a batch and late batches alike (one vectorized pass;
+        # padding slots hold 0 and cannot mask a real negative).
+        if int(cols["ts"].min()) < 0:
+            raise ValueError(
+                f"event timestamp rebases negative (base {self._ts_base}, "
+                f"margin {TS_REBASE_MARGIN_MS} ms): an event arrived more "
+                "than the margin earlier than the first batch's earliest "
+                "event; negative rebased times would collide with the "
+                "engine's -1 sentinel and silently disable window expiry"
+            )
         xs = {k: jnp.asarray(v) for k, v in cols.items()}
         xs["spred"] = eval_stateless_preds(self.query, cols)
         xs["gidx"] = jnp.asarray(gidx)
@@ -241,9 +297,18 @@ class BatchedDeviceNFA:
             self._processed_gidx = max(
                 self._processed_gidx, self._pack_hwms.popleft()
             )
+        import time as _time
+
+        t0 = _time.perf_counter()
         self.state, ys = self._advance(self.state, xs)
         self.state, self.pool = self._post(self.state, self.pool, ys)
         self._batches += 1
+        # Slot count from shape only -- counting true valids would pull the
+        # device array and break the zero-sync advance path (exact event
+        # totals live in the engine's n_events counter).
+        self.timings.record_advance(
+            _time.perf_counter() - t0, int(np.prod(xs["valid"].shape))
+        )
         out: Dict[Any, List[Sequence]] = {}
         if decode:
             out = self.drain()
@@ -254,13 +319,20 @@ class BatchedDeviceNFA:
 
         Pending ids are GC roots, remapped on every post pass, so draining
         after any number of non-decoding advances is id-consistent."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         counts = np.asarray(self.pool["pend_count"])  # [K] (1-D; K-last = K-only)
         self.last_match_counts = counts
         self._prune_events()  # registry must stay bounded on match-free streams
         if counts.sum() == 0:
+            self.timings.record_drain(_time.perf_counter() - t0, 0)
             return {}
         out = self._decode_matches(counts)
         self.pool = self._drain_pend(self.pool)
+        self.timings.record_drain(
+            _time.perf_counter() - t0, sum(len(v) for v in out.values())
+        )
         return out
 
     # --------------------------------------------------------- checkpointing
@@ -330,11 +402,48 @@ class BatchedDeviceNFA:
         return bat
 
     # ------------------------------------------------------------ internals
+    def _native_packer(self):
+        """The C packer module, or None (cached; dtype-gated)."""
+        cached = getattr(self, "_native_mod", False)
+        if cached is not False:
+            return cached
+        mod = None
+        try:
+            from ..native import load_packer
+
+            if all(
+                np.dtype(dt) in (np.dtype(np.int32), np.dtype(np.float32))
+                for dt in self.query.schema.fields.values()
+            ):
+                mod = load_packer()
+        except Exception:
+            mod = None
+        self._native_mod = mod
+        return mod
+
     def _decode_matches(self, counts: np.ndarray) -> Dict[Any, List[Sequence]]:
-        pend = np.asarray(self.pool["pend"]).T            # [K, M]
-        node_event = np.asarray(self.pool["node_event"]).T  # [K, B]
-        node_name = np.asarray(self.pool["node_name"]).T
-        node_pred = np.asarray(self.pool["node_pred"]).T
+        # Bucketed pulls: the compacted region only holds `node_count` live
+        # nodes per key (post-GC ids are dense from 0), so the dominant D2H
+        # transfer is sliced to the max live count, rounded up to a power of
+        # two to bound the number of distinct sliced programs to O(log B)
+        # (PERF.md round-3 lever 3: decode pull width).
+        max_nodes = int(np.asarray(self.pool["node_count"]).max())
+        max_pend = int(counts.max())
+        full_b = self.pool["node_event"].shape[0]
+        full_m = self.pool["pend"].shape[0]
+        Bb = 1
+        while Bb < max(max_nodes, 1):
+            Bb <<= 1
+        Bb = min(Bb, full_b)
+        Mb = 1
+        while Mb < max(max_pend, 1):
+            Mb <<= 1
+        Mb = min(Mb, full_m)
+
+        pend = np.asarray(self.pool["pend"][:Mb]).T            # [K, Mb]
+        node_event = np.asarray(self.pool["node_event"][:Bb]).T  # [K, Bb]
+        node_name = np.asarray(self.pool["node_name"][:Bb]).T
+        node_pred = np.asarray(self.pool["node_pred"][:Bb]).T
         K, B = node_event.shape
 
         # Flatten per-key pools into one index space so every chain across

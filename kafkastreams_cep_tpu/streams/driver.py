@@ -78,10 +78,16 @@ class LogDriver:
             self._positions[(topic, partition)] = default_deserializer(rec.value)
 
     def commit(self) -> None:
-        """Durably record consumer positions (and flush store caches so the
-        changelog is consistent with the committed offsets -- the reference
-        commits offsets and flushes stores together at the commit interval)."""
+        """Durably record consumer positions after making the state they
+        cover durable (the reference commits offsets and flushes stores
+        together at the commit interval).
+
+        Order matters for at-least-once: the changelog/sink appends are
+        fsynced BEFORE the offset record is appended and fsynced, so a crash
+        between the two replays the interval (deduped by the HWM) instead of
+        silently skipping records whose effects were lost."""
         self.topology.flush_stores()
+        self.log.flush()  # changelog + sink records durable first
         for (topic, partition), pos in self._positions.items():
             self.log.append(
                 OFFSETS_TOPIC,

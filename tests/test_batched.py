@@ -153,3 +153,27 @@ def test_global_stats_reduction():
     g = global_stats(bat.state)
     assert int(g["n_events"]) == sum(len(s) for s in streams.values())
     assert int(g["runs"]) == sum(bat.runs(k) for k in streams)
+
+
+def test_ts_rebase_guard_rejects_pre_base_events():
+    """An event older than base - margin must fail loudly: negative rebased
+    times would collide with the -1 sentinel and silently disable window
+    expiry (multikey differential seeds 8/10 regression)."""
+    import pytest as _pytest
+
+    from kafkastreams_cep_tpu.parallel.batched import TS_REBASE_MARGIN_MS
+
+    from kafkastreams_cep_tpu.ops.tables import compile_query
+
+    query = compile_query(compile_pattern(branching_pattern()), None)
+    bat = BatchedDeviceNFA(
+        query, keys=["a", "b"], config=EngineConfig(lanes=32, nodes=256, matches=32)
+    )
+    t0 = 10_000_000
+    bat.advance({"a": [Event("a", "A", t0, "t", 0, 0)]})
+    # Within the margin: an earlier-starting key still works...
+    out = bat.advance({"b": [Event("b", "A", t0 - 1000, "t", 0, 0)]})
+    assert isinstance(out, dict)
+    # ...but beyond it the pack refuses rather than corrupting expiry.
+    with _pytest.raises(ValueError, match="rebases negative"):
+        bat.pack({"b": [Event("b", "B", t0 - TS_REBASE_MARGIN_MS - 10, "t", 0, 1)]})

@@ -183,7 +183,7 @@ def random_pattern_extended(rng: random.Random):
     return builder.within(ms=rng.choice([4, 8, 16, 24])).build()
 
 
-@pytest.mark.parametrize("seed", range(40))
+@pytest.mark.parametrize("seed", range(60))
 def test_differential_extended(seed):
     rng = random.Random(777_000 + seed)
     pattern = random_pattern_extended(rng)
@@ -217,3 +217,73 @@ def test_differential_extended(seed):
     assert got == expected
     assert dev.runs == oracle.runs
     assert dev.n_live == len(oracle.computation_stages)
+
+
+# ---------------------------------------------------------------------------
+# Multi-key batched differential: the [T, K] engine vs K independent host
+# oracles, each key on its own stream, with ragged per-key batches (some keys
+# silent in some batches) -- the random-space counterpart of test_batched.py.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("seed", range(30))
+def test_differential_multikey(seed):
+    from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA
+
+    rng = random.Random(424_000 + seed)
+    pattern = random_pattern_extended(rng)
+    stages = compile_pattern(pattern)
+    n_keys = rng.randint(2, 4)
+    keys = [f"key{i}" for i in range(n_keys)]
+
+    # Per-key streams of different lengths; each key keeps its own offsets.
+    streams = {}
+    for key in keys:
+        events = random_stream(rng, rng.randint(24, 48))
+        streams[key] = [
+            Event(key, e.value, e.timestamp, e.topic, e.partition, e.offset)
+            for e in events
+        ]
+
+    expected = {}
+    oracles = {}
+    for key in keys:
+        oracle = NFA.build(
+            stages, AggregatesStore(), SharedVersionedBuffer(),
+            strict_windows=True,
+        )
+        oracles[key] = oracle
+        acc = []
+        for e in streams[key]:
+            acc.extend(oracle.match_pattern(e))
+        expected[key] = acc
+
+    from kafkastreams_cep_tpu.ops.engine import EngineConfig as _EC
+
+    bat = BatchedDeviceNFA(
+        compile_pattern(pattern),
+        keys=keys,
+        config=_EC(lanes=512, nodes=4096, matches=512, matches_per_step=512,
+                   strict_windows=True),
+    )
+    got = {k: [] for k in keys}
+    cursors = {k: 0 for k in keys}
+    while any(cursors[k] < len(streams[k]) for k in keys):
+        batch = {}
+        for k in keys:
+            # Ragged advance: keys progress at different rates; some keys
+            # sit a batch out entirely.
+            step = rng.randint(0, 7)
+            if step == 0 or cursors[k] >= len(streams[k]):
+                continue
+            batch[k] = streams[k][cursors[k] : cursors[k] + step]
+            cursors[k] += len(batch[k])
+        if not batch:
+            continue
+        for k, seqs in bat.advance(batch).items():
+            got[k].extend(seqs)
+
+    assert bat.stats["lane_drops"] == 0 and bat.stats["node_drops"] == 0
+    assert bat.stats["match_drops"] == 0
+    for k in keys:
+        assert got[k] == expected[k], f"key {k} diverged"
+        assert bat.runs(k) == oracles[k].runs
+        assert bat.n_live(k) == len(oracles[k].computation_stages)

@@ -1,0 +1,51 @@
+"""Observability: per-batch timings + match-emit latency histogram."""
+from __future__ import annotations
+
+import numpy as np
+
+from kafkastreams_cep_tpu import QueryBuilder, compile_pattern
+from kafkastreams_cep_tpu.core.event import Event
+from kafkastreams_cep_tpu.ops.engine import EngineConfig
+from kafkastreams_cep_tpu.ops.profiling import BatchTimings
+from kafkastreams_cep_tpu.ops.tables import compile_query
+from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA
+from kafkastreams_cep_tpu.pattern.expressions import value
+
+
+def test_batch_timings_summary_and_histogram():
+    t = BatchTimings(capacity=4)
+    t.record_advance(0.010, 64)
+    t.record_drain(0.002, 3)
+    t.record_advance(0.020, 64)
+    t.record_drain(0.001, 0)
+    s = t.summary()
+    assert s["batches"] == 2 and s["drains"] == 2
+    assert s["slots"] == 128 and s["matches"] == 3
+    assert s["emit_latency_ms_p99"] >= s["emit_latency_ms_p50"] > 0
+    h = t.histogram()
+    assert sum(h["counts"]) == h["n"] == 2
+    # Ring bound: capacity 4 keeps only the latest records.
+    for _ in range(10):
+        t.record_advance(0.001, 1)
+    assert t.summary()["batches"] <= 4
+
+
+def test_engine_records_timings():
+    pattern = (
+        QueryBuilder()
+        .select("a").where(value() == "A")
+        .then().select("b").where(value() == "B")
+        .then().select("c").where(value() == "C")
+        .build()
+    )
+    query = compile_query(compile_pattern(pattern), None)
+    bat = BatchedDeviceNFA(
+        query, keys=["x"], config=EngineConfig(lanes=8, nodes=128, matches=16)
+    )
+    events = [Event("x", v, 1000 + i, "t", 0, i) for i, v in enumerate("XABC")]
+    out = bat.advance({"x": events})
+    assert len(out.get("x", [])) == 1
+    s = bat.timings.summary()
+    assert s["batches"] == 1 and s["drains"] == 1 and s["matches"] == 1
+    assert bat.timings.histogram()["n"] == 1
+    assert s["emit_latency_ms_p50"] > 0
