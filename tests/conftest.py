@@ -25,6 +25,14 @@ import jax  # noqa: E402
 # The axon register hook sets jax_platforms=axon via jax.config at
 # interpreter start, so the env var alone no longer wins.
 jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: the differential harness compiles ~100
+# distinct programs; on a warm cache repeat suite runs skip nearly all of
+# that (the cache key includes jaxlib version + flags, so it is safe).
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 try:  # private JAX API; guarded so a JAX upgrade degrades gracefully
     from jax._src import xla_bridge as _xb  # noqa: E402
 
