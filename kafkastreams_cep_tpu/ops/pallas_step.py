@@ -1,0 +1,871 @@
+"""Fused Pallas TPU kernel for the batched NFA step (PERF.md round-3 lever 1).
+
+One kernel advances 8 keys x R run lanes through a full [T]-event micro-batch:
+grid (K/8, T) with T innermost, the engine state carried in the *output*
+refs across T (Mosaic elides the re-fetch/flush while the block index is
+unchanged, so the multi-step carry lives entirely in VMEM), and one
+(1, 8, cap) node/match output block streamed to HBM per step.
+
+This replaces the vmapped XLA scan step (ops/engine.py:build_step) whose
+per-event cost was spread across ~100s of small fusions plus scratch-space
+staging copies between them (profiled on the real chip, PERF.md "v4"): the
+kernel computes the identical transition relation -- the same unrolled
+epsilon descent, slot table, DFS emission order, counters and drop policy --
+so the two paths are interchangeable and bitwise-comparable.
+
+TPU-native forms used here (none exist in the reference, which is a
+per-record JVM loop, NFA.java:134-397):
+
+  * per-lane stage-table lookups are unrolled selects over the static stage
+    count (the kernel analog of engine.py's one-hot contractions);
+  * the lane-axis exclusive cumsum that locates each surviving slot's
+    compaction rank is a matmul against a strictly-lower-triangular
+    constant (MXU, Precision.HIGHEST -- exact for integer payloads);
+  * slot compaction itself is a batched one-hot matmul: for each of the
+    3L emission slots, out[k, f, j] += field[k, f, r] * (rank[k, r] == j),
+    an (8, F, R) @ (8, R, R) MXU contraction per slot. Integer fields ride
+    f32 lanes exactly (one-hot rows select a single value, so no rounding
+    can occur below 2^24); `seq`/`ts`/`node` split into 16-bit halves so
+    the full i32 range survives;
+  * match-id and buffer-node emission reuse the same rank/one-hot machinery
+    with j ranging over matches_per_step / nodes_per_step.
+
+Sentinel encoding: -1-valued fields (eps, node, ts) are biased by +1 before
+the 16-bit split and unbiased after selection.
+
+The kernel is engaged by BatchedDeviceNFA(engine="pallas"|"auto"); the XLA
+scan step remains the fallback (mesh-sharded runs, unsupported configs, and
+non-TPU platforms) and the conformance oracle for this kernel's tests.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..pattern.expressions import Env
+from .engine import EngineConfig
+from .tables import (
+    OP_BEGIN,
+    OP_NONE,
+    OP_TAKE,
+    PR_NONE,
+    PR_PROCEED,
+    PR_SKIP,
+    CompiledQuery,
+)
+
+_I32_MAX = np.int64(2**31 - 1)
+HI = jax.lax.Precision.HIGHEST
+
+#: per-lane i32 state fields, in the stacked-lanes array order.
+LANE_FIELDS = (
+    "active", "src", "eps", "vlen", "seq", "node", "ts", "branching", "ignored",
+)
+#: per-key scalar counters, in the stacked-counters array order.
+COUNTER_FIELDS = (
+    "runs", "n_events", "n_branches", "n_expired",
+    "lane_drops", "node_drops", "match_drops", "seq_collisions",
+)
+
+
+def supports_pallas(query: CompiledQuery, config: EngineConfig) -> Optional[str]:
+    """None if the fused kernel can run this query/config, else the reason."""
+    R = config.lanes
+    L = query.max_depth
+    p_cap = config.nodes_per_step if config.nodes_per_step > 0 else R * L
+    if p_cap > 512:
+        return f"nodes_per_step window {p_cap} > 512 (VMEM budget)"
+    if config.matches_per_step > 256:
+        return f"matches_per_step {config.matches_per_step} > 256"
+    # Node ids must survive a single f32 one-hot lane (< 2^24); the window
+    # base grows with the batch length, checked per-advance in the builder.
+    if config.nodes >= (1 << 24):
+        return f"node pool {config.nodes} >= 2^24 (f32-exact id transport)"
+    return None
+
+
+class PallasEnv(Env):
+    """Expression environment inside the kernel: (8, 1) per-key event
+    scalars broadcasting against (8, R) fold-register planes."""
+
+    def __init__(
+        self,
+        event: Dict[str, jnp.ndarray],
+        regs: List[jnp.ndarray],
+        regs_set: List[jnp.ndarray],
+        agg_slots: Dict[str, int],
+        defaults: Dict[str, float],
+    ) -> None:
+        self._event = event
+        self._regs = regs
+        self._regs_set = regs_set
+        self._agg_slots = agg_slots
+        self._defaults = defaults
+
+    def field(self, name: str) -> Any:
+        return self._event[f"f:{name}"]
+
+    def value(self) -> Any:
+        return self._event["f:"]
+
+    def key(self) -> Any:
+        raise NotImplementedError("key() is not available in device predicates")
+
+    def timestamp(self) -> Any:
+        return self._event["ts"]
+
+    def topic_is(self, topic_code: Any) -> Any:
+        return self._event["topic"] == topic_code
+
+    def agg(self, name: str, default: Any = None) -> Any:
+        slot = self._agg_slots.get(name)
+        fallback = default if default is not None else self._defaults.get(name, 0)
+        if slot is None:
+            return jnp.float32(fallback)
+        return jnp.where(
+            self._regs_set[slot] != 0, self._regs[slot], jnp.float32(fallback)
+        )
+
+    def true(self) -> Any:
+        return True
+
+
+def _split16(v: jnp.ndarray, bias: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(lo, hi) f32 halves of a biased i32 (v + bias must be >= 0)."""
+    u = v + bias
+    return (u & 0xFFFF).astype(jnp.float32), (u >> 16).astype(jnp.float32)
+
+
+def _join16(lo: jnp.ndarray, hi: jnp.ndarray, bias: int) -> jnp.ndarray:
+    return (
+        (hi.astype(jnp.int32) << 16) | lo.astype(jnp.int32)
+    ) - bias
+
+
+def build_pallas_batched_advance(
+    query: CompiledQuery,
+    config: EngineConfig,
+    interpret: bool = False,
+):
+    """jit advance(state, xs) -> (state, ys) running the fused kernel.
+
+    Contract-identical to key_shard.build_batched_advance except ys leaves
+    are [T, K, cap] (key axis second) -- pair with
+    build_pallas_batched_post. K must be a multiple of 8.
+    """
+    R = config.lanes
+    D = config.dewey_width(query)
+    A = query.n_aggs
+    B = config.nodes
+    M_STEP = config.matches_per_step
+    L = query.max_depth
+    P = query.n_preds
+    SLOTS = 3 * L
+    P_CAP = config.nodes_per_step if config.nodes_per_step > 0 else R * L
+    NF = len(LANE_FIELDS)
+    NC = len(COUNTER_FIELDS)
+    reason = supports_pallas(query, config)
+    if reason is not None:
+        raise ValueError(f"pallas step unsupported: {reason}")
+
+    # -- static stage tables (host numpy; unrolled into selects) -----------
+    n_consume_op = np.asarray(query.consume_op)
+    n_consume_pred = np.asarray(query.consume_pred)
+    n_consume_target = np.asarray(query.consume_target)
+    n_ignore_pred = np.asarray(query.ignore_pred)
+    n_proceed_kind = np.asarray(query.proceed_kind)
+    n_proceed_pred = np.asarray(query.proceed_pred)
+    n_proceed_target = np.asarray(query.proceed_target)
+    n_window = np.where(
+        query.window_ms < 0, -1, np.minimum(query.window_ms, _I32_MAX - 1)
+    ).astype(np.int32)
+    n_name_id = np.asarray(query.name_id)
+    n_pure_name = np.asarray(query.pure_name_id)
+    n_is_begin = np.asarray(query.is_begin)
+    n_is_final = np.asarray(query.is_final)
+    n_is_fwd = np.asarray(query.is_fwd)
+    n_fwd_final = np.asarray(query.fwd_final)
+    N_ST = len(n_consume_op)
+    n_pure_of_ptgt = n_pure_name[n_proceed_target.clip(0)]
+    n_isfin_of_ctgt = n_is_final[n_consume_target.clip(0)] & (n_consume_target >= 0)
+    stateful = [bool(f) for f in query.pred_stateful]
+
+    flat_folds: List[Tuple[int, int, Callable]] = []
+    for stage_i, stage_folds in enumerate(query.folds):
+        for slot, fn in stage_folds:
+            flat_folds.append((stage_i, slot, fn))
+
+    int_fields = [
+        name for name, dt in query.schema.fields.items()
+        if np.dtype(dt) != np.dtype(np.float32)
+    ]
+    f32_fields = [
+        name for name, dt in query.schema.fields.items()
+        if np.dtype(dt) == np.dtype(np.float32)
+    ]
+    # xi column order: ts, topic, gidx, valid, ints..., spred...
+    XI_BASE = 4
+    CI = XI_BASE + len(int_fields) + P
+    CF = len(f32_fields)
+
+    def lut_i(ids: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
+        """Unrolled per-lane table lookup (ids -1 -> 0)."""
+        acc = jnp.zeros_like(ids)
+        for i in range(N_ST):
+            v = int(table[i])
+            if v != 0:
+                acc = jnp.where(ids == i, jnp.int32(v), acc)
+        return acc
+
+    def lut_b(ids: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
+        """Unrolled boolean lookup (ids -1 -> False)."""
+        acc = jnp.zeros(ids.shape, bool)
+        for i in range(N_ST):
+            if bool(table[i]):
+                acc = acc | (ids == i)
+        return acc
+
+    # Triangular matrix for lane-axis exclusive cumsums (tri[r', r] = 1 iff
+    # r' < r, so  counts @ tri  is the exclusive scan). Built with iota
+    # inside the kernel: pallas kernels cannot capture traced constants.
+    def make_tri() -> jnp.ndarray:
+        ii = jax.lax.broadcasted_iota(jnp.int32, (R, R), 0)
+        jj = jax.lax.broadcasted_iota(jnp.int32, (R, R), 1)
+        return (ii < jj).astype(jnp.float32)
+
+    def excl_lane_cumsum(cnt_f: jnp.ndarray, tri: jnp.ndarray) -> jnp.ndarray:
+        """[8, R] f32 counts -> [8, R] exclusive cumsum along lanes (exact)."""
+        return jax.lax.dot_general(
+            cnt_f, tri, (((1,), (0,)), ((), ())), precision=HI
+        )
+
+    def select_slots(
+        masks: List[jnp.ndarray],
+        ranks: List[jnp.ndarray],
+        fields_per_slot: List[List[jnp.ndarray]],
+        n_out: int,
+    ) -> jnp.ndarray:
+        """DFS-order one-hot compaction: output [8, F, n_out] f32 where
+        out[k, :, j] = the slot fields at the j-th set mask bit in
+        (lane-major, slot-minor) rank order. Unselected j stay 0."""
+        jiota = jax.lax.broadcasted_iota(jnp.int32, (1, 1, n_out), 2)
+        sel = None
+        for mask, rank, fields in zip(masks, ranks, fields_per_slot):
+            oh = (
+                (rank[:, :, None] == jiota)
+                & (mask.astype(jnp.int32)[:, :, None] != 0)
+            ).astype(jnp.float32)  # (8, R, n_out)
+            ft = jnp.stack(fields, axis=1)  # (8, F, R)
+            part = jax.lax.dot_general(
+                ft, oh, (((2,), (1,)), ((0,), (0,))), precision=HI
+            )
+            sel = part if sel is None else sel + part
+        return sel
+
+    def kernel(
+        xi_ref, xf_ref, lanes_ref, ver_ref, regs_ref, rset_ref, ctr_ref,
+        lanes_o, ver_o, regs_o, rset_o, ctr_o, wev_o, wnm_o, wpr_o, wmt_o,
+    ):
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _():
+            lanes_o[...] = lanes_ref[...]
+            ver_o[...] = ver_ref[...]
+            regs_o[...] = regs_ref[...]
+            rset_o[...] = rset_ref[...]
+            ctr_o[...] = ctr_ref[...]
+
+        # -- load carried state (8, R) planes -------------------------------
+        st = {name: lanes_o[i] for i, name in enumerate(LANE_FIELDS)}
+        ver0 = [ver_o[d] for d in range(D)]
+        regs0 = [regs_o[a] for a in range(A)]
+        rset0 = [rset_o[a] for a in range(A)]
+        ctr = ctr_o[...]  # (8, NC) i32
+
+        xi = xi_ref[0]  # (8, CI) i32
+        xf = xf_ref[0]  # (8, max(CF,1)) f32
+        ev_ts = xi[:, 0:1]
+        topic = xi[:, 1:2]
+        gidx = xi[:, 2:3]
+        valid = xi[:, 3:4] != 0  # (8, 1) bool
+        event: Dict[str, jnp.ndarray] = {"ts": ev_ts, "topic": topic}
+        for ci, name in enumerate(int_fields):
+            event[f"f:{name}"] = xi[:, XI_BASE + ci : XI_BASE + ci + 1]
+        for cf, name in enumerate(f32_fields):
+            event[f"f:{name}"] = xf[:, cf : cf + 1]
+
+        active = st["active"] != 0
+        src = st["src"]
+        eps = st["eps"]
+        lane_node = st["node"]
+        lane_ts = st["ts"]
+        lane_seq = st["seq"]
+        runs = ctr[:, 0:1]
+
+        # -- predicate plane list (stateless from xi, stateful in-kernel) ---
+        env = PallasEnv(event, regs0, rset0, query.agg_slots, query.agg_defaults)
+        pred_vals: List[jnp.ndarray] = []
+        for p in range(P):
+            if stateful[p]:
+                v = query.predicates[p](env)
+                pred_vals.append(
+                    jnp.broadcast_to(jnp.asarray(v, bool), (8, R))
+                )
+            else:
+                sp = xi[:, XI_BASE + len(int_fields) + p :
+                        XI_BASE + len(int_fields) + p + 1]
+                pred_vals.append(jnp.broadcast_to(sp != 0, (8, R)))
+
+        def lut_pred(ids: jnp.ndarray, pid_table: np.ndarray) -> jnp.ndarray:
+            acc = jnp.zeros(ids.shape, bool)
+            for i in range(N_ST):
+                pid = int(pid_table[i])
+                if pid >= 0:
+                    acc = acc | ((ids == i) & pred_vals[pid])
+            return acc
+
+        # -- window expiry (engine.py:330-352) -------------------------------
+        root_begin = lut_b(src, n_is_begin)
+        w_src = lut_i(src, n_window)
+        if config.strict_windows:
+            w_eps = lut_i(eps, n_window)
+            w_eps = jnp.where(w_eps >= 0, w_eps, w_src)
+            eff_window = jnp.where(eps >= 0, w_eps, w_src)
+            expired = (
+                active & (lane_ts >= 0) & (eff_window >= 0)
+                & ((ev_ts - lane_ts) > eff_window)
+            )
+        else:
+            eff_window = jnp.where(eps >= 0, -1, w_src)
+            expired = (
+                active & ~root_begin & (eff_window >= 0)
+                & ((ev_ts - lane_ts) > eff_window)
+            )
+        active = active & ~expired
+
+        root_fwd = (eps >= 0) | lut_b(src, n_is_fwd)
+        start_ts = jnp.where(root_begin, jnp.broadcast_to(ev_ts, (8, R)), lane_ts)
+        state_match = ((eps >= 0) & lut_b(eps, n_is_final)) | (
+            (eps < 0) & lut_b(src, n_fwd_final)
+        )
+
+        # ==== downward pass: unrolled epsilon descent (engine.py:362-424) ===
+        alive = active
+        cs = src
+        is_eps = eps >= 0
+        ceps = eps
+        ver = ver0
+        vlen = st["vlen"]
+        br = st["branching"] != 0
+        ig = st["ignored"] != 0
+        ps = jnp.full((8, R), -1, jnp.int32)
+
+        levels: List[Dict[str, Any]] = []
+        for _l in range(L):
+            c_op = jnp.where(is_eps, OP_NONE, lut_i(cs, n_consume_op))
+            c_m = (
+                alive & ~is_eps & (c_op != OP_NONE)
+                & lut_pred(cs, n_consume_pred)
+            )
+            take_m = c_m & (c_op == OP_TAKE)
+            begin_m = c_m & (c_op == OP_BEGIN)
+            ig_m = alive & ~is_eps & lut_pred(cs, n_ignore_pred)
+            pk = jnp.where(is_eps, PR_PROCEED, lut_i(cs, n_proceed_kind))
+            ptgt = jnp.where(is_eps, ceps, lut_i(cs, n_proceed_target))
+            p_m = alive & (pk != PR_NONE) & (is_eps | lut_pred(cs, n_proceed_pred))
+            p_strict = p_m & (pk == PR_PROCEED)
+            branch_m = (p_strict & take_m) | (ig_m & (c_m | p_strict))
+
+            ptgt_c = jnp.maximum(ptgt, 0)
+            pure_tgt = lut_i(cs, n_pure_of_ptgt)
+            if _l == 0:
+                pure_tgt = jnp.where(is_eps, lut_i(ceps, n_pure_name), pure_tgt)
+            fwd_next = (
+                p_m & (pure_tgt != lut_i(cs, n_pure_name)) & ~br & ~ig
+            )
+
+            levels.append(
+                dict(
+                    alive=alive, cs=cs, is_eps=is_eps, ver=ver, vlen=vlen,
+                    br=br, ig=ig, ps=ps, c_m=c_m, take_m=take_m,
+                    begin_m=begin_m, ig_m=ig_m, p_m=p_m, pk=pk, ptgt=ptgt_c,
+                    branch_m=branch_m,
+                )
+            )
+
+            vlen = jnp.where(fwd_next, vlen + 1, vlen)
+            br = br & ~fwd_next
+            ig = ig & ~fwd_next
+            ps = jnp.where(pk == PR_SKIP, ps, cs).astype(jnp.int32)
+            alive = p_m
+            cs = ptgt_c
+            is_eps = jnp.zeros((8, R), bool)
+            ceps = jnp.full((8, R), -1, jnp.int32)
+
+        # ==== fold-register chain (deepest first, engine.py:426-444) =======
+        def apply_folds(v, regs, rset):
+            regs, rset = list(regs), list(rset)
+            for stage_i, slot, fn in flat_folds:
+                mask = v["c_m"] & (v["cs"] == stage_i)
+                fenv = PallasEnv(
+                    event, regs, rset, query.agg_slots, query.agg_defaults
+                )
+                val = jnp.broadcast_to(
+                    jnp.asarray(fn(fenv), jnp.float32), (8, R)
+                )
+                regs[slot] = jnp.where(mask, val, regs[slot])
+                rset[slot] = rset[slot] | mask
+            return regs, rset
+
+        cur_regs = regs0
+        cur_set = [r != 0 for r in rset0]
+        clone_regs: List[Any] = [None] * L
+        for l in reversed(range(L)):
+            clone_regs[l] = (cur_regs, cur_set)
+            if flat_folds:
+                cur_regs, cur_set = apply_folds(levels[l], cur_regs, cur_set)
+        final_regs, final_set = cur_regs, cur_set
+
+        # -- same-run-id collision detector (engine.py:447-452) -------------
+        consuming = jnp.zeros((8, R), bool)
+        for l in range(L):
+            consuming = consuming | levels[l]["c_m"]
+        seq_i = lane_seq[:, :, None]
+        pair = (
+            (seq_i == lane_seq[:, None, :])
+            & (consuming.astype(jnp.int32)[:, :, None] != 0)
+            & (consuming.astype(jnp.int32)[:, None, :] != 0)
+            & (
+                jax.lax.broadcasted_iota(jnp.int32, (1, R, R), 1)
+                < jax.lax.broadcasted_iota(jnp.int32, (1, R, R), 2)
+            )
+        )
+        collide = jnp.any(
+            jnp.any(pair, axis=2), axis=1, keepdims=True
+        )  # (8, 1)
+
+        # ==== buffer puts: rank + one-hot emit (engine.py:454-482) ==========
+        tri = make_tri()
+        put_masks = [levels[l]["c_m"] for l in range(L)]
+        put_cnt = jnp.zeros((8, R), jnp.int32)
+        for m in put_masks:
+            put_cnt = put_cnt + m.astype(jnp.int32)
+        put_off = excl_lane_cumsum(put_cnt.astype(jnp.float32), tri).astype(jnp.int32)
+        put_ranks: List[jnp.ndarray] = []
+        partial = jnp.zeros((8, R), jnp.int32)
+        for m in put_masks:
+            put_ranks.append(put_off + partial)
+            partial = partial + m.astype(jnp.int32)
+        n_put = jnp.sum(put_cnt, axis=1, keepdims=True)  # (8, 1)
+
+        base = B + t * P_CAP  # window base for this step's node ids
+        put_idx = [
+            jnp.where(
+                put_masks[l] & (put_ranks[l] < P_CAP),
+                base + put_ranks[l],
+                -1,
+            ).astype(jnp.int32)
+            for l in range(L)
+        ]
+        name_planes = [lut_i(levels[l]["cs"], n_name_id) for l in range(L)]
+        # w_event is gidx for every real put slot -- rank order makes it a
+        # prefix, no selection needed.
+        put_j = jax.lax.broadcasted_iota(jnp.int32, (8, P_CAP), 1)
+        put_jok = put_j < jnp.minimum(n_put, P_CAP)
+        w_event = jnp.where(
+            put_jok & valid, jnp.broadcast_to(gidx, (8, P_CAP)), -1
+        ).astype(jnp.int32)
+        psel = select_slots(
+            put_masks,
+            put_ranks,
+            [
+                [
+                    name_planes[l].astype(jnp.float32),
+                    (lane_node + 1).astype(jnp.float32),  # bias -1 -> 0
+                ]
+                for l in range(L)
+            ],
+            P_CAP,
+        )
+        w_name = jnp.where(put_jok & valid, psel[:, 0, :].astype(jnp.int32), -1)
+        w_pred = jnp.where(
+            put_jok & valid, psel[:, 1, :].astype(jnp.int32) - 1, -1
+        )
+        step_node_drops = jnp.maximum(n_put - P_CAP, 0)
+
+        # ==== upward pass (engine.py:484-507) ===============================
+        desc_any = jnp.zeros((8, R), bool)
+        up: List[Optional[Dict[str, Any]]] = [None] * L
+        for l in reversed(range(L)):
+            v = levels[l]
+            ignore_emit = v["ig_m"] & ~v["branch_m"]
+            clone_m = v["branch_m"] & v["c_m"]
+            rootcopy_m = v["branch_m"] & ~v["c_m"] & ~desc_any
+            readd_cond = root_begin & ~root_fwd & v["alive"]
+            readd_fresh = readd_cond & v["c_m"]
+            readd_root = readd_cond & ~v["c_m"]
+            ns_before = v["c_m"] | ignore_emit | desc_any | clone_m | rootcopy_m
+            add_mask = readd_fresh & ns_before
+            idx1 = v["vlen"] - 1  # addRun offset 1
+            readd_ver = [
+                v["ver"][d] + (add_mask & (idx1 == d)).astype(jnp.int32)
+                for d in range(D)
+            ]
+            up[l] = dict(
+                ignore_emit=ignore_emit, clone_m=clone_m, rootcopy_m=rootcopy_m,
+                readd_fresh=readd_fresh, readd_root=readd_root,
+                readd_ver=readd_ver,
+            )
+            desc_any = ns_before | readd_fresh | readd_root
+
+        # ==== output slot table in oracle DFS order (engine.py:509-620) =====
+        zero = jnp.zeros((8, R), jnp.int32)
+        false2 = jnp.zeros((8, R), bool)
+        f32z = jnp.zeros((8, R), jnp.float32)
+
+        slots: List[Dict[str, Any]] = []
+        for l in range(L):
+            v = levels[l]
+            c_eps = jnp.where(
+                v["take_m"], v["cs"], lut_i(v["cs"], n_consume_target)
+            )
+            ign = up[l]["ignore_emit"]
+            c_m = v["c_m"]
+            match_consume = (v["take_m"] & lut_b(v["cs"], n_is_final)) | (
+                ~v["take_m"] & lut_b(v["cs"], n_isfin_of_ctgt)
+            )
+            slots.append(
+                dict(
+                    occ=c_m | ign,
+                    src=jnp.where(c_m, v["cs"], src),
+                    eps=jnp.where(c_m, c_eps, eps),
+                    ver=v["ver"],
+                    vlen=v["vlen"],
+                    seq=lane_seq,
+                    node=jnp.where(c_m, put_idx[l], lane_node),
+                    ts=jnp.where(c_m, start_ts, lane_ts),
+                    br=false2,
+                    ig=~c_m,
+                    newseq=false2,
+                    regs=final_regs,
+                    regs_set=final_set,
+                    match=(c_m & match_consume) | (~c_m & state_match),
+                )
+            )
+
+        for l in reversed(range(L)):
+            v = levels[l]
+            u = up[l]
+            has_ps = v["ps"] >= 0
+            cl_src = jnp.where(has_ps, v["ps"], v["cs"])
+            ps_begin = ~has_ps | lut_b(v["ps"], n_is_begin)
+            off = jnp.where(ps_begin & (v["vlen"] >= 2), 2, 1).astype(jnp.int32)
+            idx = v["vlen"] - off
+            m_clone = u["clone_m"]
+            cl_ver = [
+                v["ver"][d] + (m_clone & (idx == d)).astype(jnp.int32)
+                for d in range(D)
+            ]
+            cl_node = jnp.where(v["ig_m"], lane_node, put_idx[l])
+            m_copy = u["rootcopy_m"]
+            cr, cr_set = clone_regs[l]
+            slots.append(
+                dict(
+                    occ=m_clone | m_copy,
+                    src=jnp.where(m_clone, cl_src, src),
+                    eps=jnp.where(m_clone, v["cs"], eps),
+                    ver=[
+                        jnp.where(m_clone, cl_ver[d], ver0[d]) for d in range(D)
+                    ],
+                    vlen=jnp.where(m_clone, v["vlen"], st["vlen"]),
+                    seq=jnp.where(m_clone, zero, lane_seq),
+                    node=jnp.where(m_clone, cl_node, lane_node),
+                    ts=jnp.where(m_clone, start_ts, lane_ts),
+                    br=m_clone | (st["branching"] != 0),
+                    ig=~m_clone & (st["ignored"] != 0),
+                    newseq=m_clone,
+                    regs=[
+                        jnp.where(m_clone, cr[a], final_regs[a]) for a in range(A)
+                    ],
+                    regs_set=[
+                        (m_clone & cr_set[a]) | (~m_clone & final_set[a])
+                        for a in range(A)
+                    ],
+                    match=(m_clone & lut_b(v["cs"], n_is_final))
+                    | (~m_clone & state_match),
+                )
+            )
+
+            m_fresh = u["readd_fresh"]
+            m_root = u["readd_root"]
+            slots.append(
+                dict(
+                    occ=m_fresh | m_root,
+                    src=src,
+                    eps=eps,
+                    ver=[
+                        jnp.where(m_fresh, u["readd_ver"][d], ver0[d])
+                        for d in range(D)
+                    ],
+                    vlen=jnp.where(m_fresh, v["vlen"], st["vlen"]),
+                    seq=jnp.where(m_fresh, zero, lane_seq),
+                    node=jnp.where(m_fresh, -1, lane_node),
+                    ts=jnp.where(m_fresh, -1, lane_ts),
+                    br=~m_fresh & (st["branching"] != 0),
+                    ig=~m_fresh & (st["ignored"] != 0),
+                    newseq=m_fresh,
+                    regs=[
+                        jnp.where(m_fresh, f32z, final_regs[a]) for a in range(A)
+                    ],
+                    regs_set=[~m_fresh & final_set[a] for a in range(A)],
+                    match=state_match,
+                )
+            )
+
+        # ==== fresh run ids in (lane, slot) DFS order (engine.py:636-643) ===
+        ns_masks = [s["occ"] & s["newseq"] for s in slots]
+        ns_cnt = jnp.zeros((8, R), jnp.int32)
+        for m in ns_masks:
+            ns_cnt = ns_cnt + m.astype(jnp.int32)
+        ns_off = excl_lane_cumsum(ns_cnt.astype(jnp.float32), tri).astype(jnp.int32)
+        partial = jnp.zeros((8, R), jnp.int32)
+        n_new = jnp.sum(ns_cnt, axis=1, keepdims=True)
+        for s, m in zip(slots, ns_masks):
+            s["seq"] = jnp.where(m, runs + 1 + ns_off + partial, s["seq"])
+            partial = partial + m.astype(jnp.int32)
+        new_runs = runs + n_new
+
+        # ==== match extraction + lane compaction (engine.py:645-679) ========
+        match_masks = [s["occ"] & s["match"] for s in slots]
+        keep_masks = [s["occ"] & ~s["match"] for s in slots]
+
+        def dfs_ranks(masks):
+            cnt = jnp.zeros((8, R), jnp.int32)
+            for m in masks:
+                cnt = cnt + m.astype(jnp.int32)
+            off = excl_lane_cumsum(cnt.astype(jnp.float32), tri).astype(jnp.int32)
+            ranks = []
+            part = jnp.zeros((8, R), jnp.int32)
+            for m in masks:
+                ranks.append(off + part)
+                part = part + m.astype(jnp.int32)
+            return ranks, jnp.sum(cnt, axis=1, keepdims=True)
+
+        m_ranks, n_match = dfs_ranks(match_masks)
+        k_ranks, n_keep = dfs_ranks(keep_masks)
+
+        msel = select_slots(
+            match_masks, m_ranks,
+            [[(s["node"] + 1).astype(jnp.float32)] for s in slots],
+            M_STEP,
+        )
+        mj = jax.lax.broadcasted_iota(jnp.int32, (8, M_STEP), 1)
+        mok = mj < jnp.minimum(n_match, M_STEP)
+        w_match = jnp.where(
+            mok & valid, msel[:, 0, :].astype(jnp.int32) - 1, -1
+        )
+        step_match_drops = jnp.maximum(n_match - M_STEP, 0)
+        lane_drop_count = jnp.maximum(n_keep - R, 0)
+
+        # Field packing for the state compaction matmul. Integer payloads
+        # ride one f32 lane each (exact below 2^24); seq (run ids), ts and
+        # node get 16-bit splits for full i32 range.
+        def slot_fields(s) -> List[jnp.ndarray]:
+            seq_lo, seq_hi = _split16(s["seq"], 0)
+            ts_lo, ts_hi = _split16(s["ts"], 1)
+            nd_lo, nd_hi = _split16(s["node"], 1)
+            out = [
+                s["src"].astype(jnp.float32),
+                (s["eps"] + 1).astype(jnp.float32),
+                s["vlen"].astype(jnp.float32),
+                s["br"].astype(jnp.float32),
+                s["ig"].astype(jnp.float32),
+                seq_lo, seq_hi, ts_lo, ts_hi, nd_lo, nd_hi,
+            ]
+            out.extend(s["ver"][d].astype(jnp.float32) for d in range(D))
+            out.extend(s["regs"])
+            out.extend(s["regs_set"][a].astype(jnp.float32) for a in range(A))
+            return out
+
+        F_FIX = 11
+        ksel = select_slots(
+            keep_masks, k_ranks, [slot_fields(s) for s in slots], R
+        )
+        jr = jax.lax.broadcasted_iota(jnp.int32, (8, R), 1)
+        lane_ok = jr < jnp.minimum(n_keep, R)
+
+        def pick_i(i: int, fill: int) -> jnp.ndarray:
+            return jnp.where(lane_ok, ksel[:, i, :].astype(jnp.int32), fill)
+
+        n_src = pick_i(0, 0)
+        n_eps = jnp.where(lane_ok, ksel[:, 1, :].astype(jnp.int32) - 1, -1)
+        n_vlen = pick_i(2, 0)
+        n_br = pick_i(3, 0)
+        n_ig = pick_i(4, 0)
+        n_seq = jnp.where(lane_ok, _join16(ksel[:, 5, :], ksel[:, 6, :], 0), 0)
+        n_ts = jnp.where(lane_ok, _join16(ksel[:, 7, :], ksel[:, 8, :], 1), -1)
+        n_node = jnp.where(lane_ok, _join16(ksel[:, 9, :], ksel[:, 10, :], 1), -1)
+        n_ver = [
+            jnp.where(lane_ok, ksel[:, F_FIX + d, :].astype(jnp.int32), 0)
+            for d in range(D)
+        ]
+        n_regs = [
+            jnp.where(lane_ok, ksel[:, F_FIX + D + a, :], 0.0) for a in range(A)
+        ]
+        n_rset = [
+            jnp.where(lane_ok, ksel[:, F_FIX + D + A + a, :].astype(jnp.int32), 0)
+            for a in range(A)
+        ]
+
+        # ==== counters + masked write-back ==================================
+        n_branch = jnp.zeros((8, R), jnp.int32)
+        for u in up:
+            n_branch = n_branch + u["clone_m"].astype(jnp.int32)
+        deltas = [
+            n_new,                                                  # runs
+            jnp.ones((8, 1), jnp.int32),                            # n_events
+            jnp.sum(n_branch, axis=1, keepdims=True),               # n_branches
+            jnp.sum(expired.astype(jnp.int32), axis=1, keepdims=True),
+            lane_drop_count,
+            step_node_drops,
+            step_match_drops,
+            collide.astype(jnp.int32),
+        ]
+        vmask = valid  # (8, 1)
+        new_ctr = ctr + jnp.where(
+            vmask, jnp.concatenate(deltas, axis=1), 0
+        )
+        ctr_o[...] = new_ctr
+
+        vm = jnp.broadcast_to(vmask, (8, R))
+        new_lanes = {
+            "active": ((vm & lane_ok) | (~vm & active)).astype(jnp.int32),
+            "src": jnp.where(vm, n_src, src),
+            "eps": jnp.where(vm, n_eps, eps),
+            "vlen": jnp.where(vm, n_vlen, st["vlen"]),
+            "seq": jnp.where(vm, n_seq, lane_seq),
+            "node": jnp.where(vm, n_node, lane_node),
+            "ts": jnp.where(vm, n_ts, lane_ts),
+            "branching": jnp.where(vm, n_br, st["branching"]),
+            "ignored": jnp.where(vm, n_ig, st["ignored"]),
+        }
+        for i, name in enumerate(LANE_FIELDS):
+            lanes_o[i] = new_lanes[name].astype(jnp.int32)
+        for d in range(D):
+            ver_o[d] = jnp.where(vm, n_ver[d], ver0[d])
+        for a in range(A):
+            regs_o[a] = jnp.where(vm, n_regs[a], regs0[a])
+            rset_o[a] = jnp.where(vm, n_rset[a], rset0[a])
+
+        wev_o[0] = w_event
+        wnm_o[0] = w_name
+        wpr_o[0] = w_pred
+        wmt_o[0] = w_match
+
+    @jax.jit
+    def advance(state, xs):
+        T, K = xs["valid"].shape
+        if K % 8 != 0:
+            raise ValueError(f"pallas advance needs K % 8 == 0, got {K}")
+        if B + T * P_CAP >= (1 << 24):
+            raise ValueError(
+                "node-id window exceeds f32-exact range; shrink the batch "
+                f"or nodes_per_step (B={B}, T={T}, cap={P_CAP})"
+            )
+        # -- pack xi [T, K, CI] / xf [T, K, max(CF,1)] -----------------------
+        spred = xs["spred"]  # [T, K, P]
+        xi_cols = [
+            xs["ts"].astype(jnp.int32),
+            xs["topic"].astype(jnp.int32),
+            xs["gidx"].astype(jnp.int32),
+            xs["valid"].astype(jnp.int32),
+        ]
+        xi_cols += [xs[f"f:{n}"].astype(jnp.int32) for n in int_fields]
+        xi = jnp.concatenate(
+            [c[:, :, None] for c in xi_cols] + [spred.astype(jnp.int32)], axis=2
+        )
+        if CF:
+            xf = jnp.stack([xs[f"f:{n}"] for n in f32_fields], axis=2)
+        else:
+            xf = jnp.zeros((T, K, 1), jnp.float32)
+
+        # -- state -> kernel layouts ----------------------------------------
+        lanes = jnp.stack(
+            [jnp.transpose(state[n].astype(jnp.int32)) for n in LANE_FIELDS],
+            axis=0,
+        )  # [NF, K, R]
+        ver = jnp.transpose(state["ver"], (1, 2, 0))        # [D, K, R]
+        regs = jnp.transpose(state["regs"], (1, 2, 0))      # [A, K, R]
+        rset = jnp.transpose(state["regs_set"], (1, 2, 0)).astype(jnp.int32)
+        ctr = jnp.stack(
+            [state[c].astype(jnp.int32) for c in COUNTER_FIELDS], axis=1
+        )  # [K, NC]
+
+        grid = (K // 8, T)
+        outs = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 8, CI), lambda kb, t: (t, kb, 0)),
+                pl.BlockSpec((1, 8, max(CF, 1)), lambda kb, t: (t, kb, 0)),
+                pl.BlockSpec((NF, 8, R), lambda kb, t: (0, kb, 0)),
+                pl.BlockSpec((D, 8, R), lambda kb, t: (0, kb, 0)),
+                pl.BlockSpec((A, 8, R), lambda kb, t: (0, kb, 0)),
+                pl.BlockSpec((A, 8, R), lambda kb, t: (0, kb, 0)),
+                pl.BlockSpec((8, NC), lambda kb, t: (kb, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((NF, 8, R), lambda kb, t: (0, kb, 0)),
+                pl.BlockSpec((D, 8, R), lambda kb, t: (0, kb, 0)),
+                pl.BlockSpec((A, 8, R), lambda kb, t: (0, kb, 0)),
+                pl.BlockSpec((A, 8, R), lambda kb, t: (0, kb, 0)),
+                pl.BlockSpec((8, NC), lambda kb, t: (kb, 0)),
+                pl.BlockSpec((1, 8, P_CAP), lambda kb, t: (t, kb, 0)),
+                pl.BlockSpec((1, 8, P_CAP), lambda kb, t: (t, kb, 0)),
+                pl.BlockSpec((1, 8, P_CAP), lambda kb, t: (t, kb, 0)),
+                pl.BlockSpec((1, 8, M_STEP), lambda kb, t: (t, kb, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((NF, K, R), jnp.int32),
+                jax.ShapeDtypeStruct((D, K, R), jnp.int32),
+                jax.ShapeDtypeStruct((A, K, R), jnp.float32),
+                jax.ShapeDtypeStruct((A, K, R), jnp.int32),
+                jax.ShapeDtypeStruct((K, NC), jnp.int32),
+                jax.ShapeDtypeStruct((T, K, P_CAP), jnp.int32),
+                jax.ShapeDtypeStruct((T, K, P_CAP), jnp.int32),
+                jax.ShapeDtypeStruct((T, K, P_CAP), jnp.int32),
+                jax.ShapeDtypeStruct((T, K, M_STEP), jnp.int32),
+            ],
+            interpret=interpret,
+        )(xi, xf, lanes, ver, regs, rset, ctr)
+        lanes_o, ver_o, regs_o, rset_o, ctr_o, wev, wnm, wpr, wmt = outs
+
+        new_state = dict(state)
+        for i, name in enumerate(LANE_FIELDS):
+            leaf = jnp.transpose(lanes_o[i])  # [R, K]
+            if name in ("active", "branching", "ignored"):
+                leaf = leaf.astype(bool)
+            new_state[name] = leaf
+        new_state["ver"] = jnp.transpose(ver_o, (2, 0, 1))
+        new_state["regs"] = jnp.transpose(regs_o, (2, 0, 1))
+        new_state["regs_set"] = jnp.transpose(rset_o, (2, 0, 1)).astype(bool)
+        for i, c in enumerate(COUNTER_FIELDS):
+            new_state[c] = ctr_o[:, i].astype(jnp.int32)
+        ys = {"w_event": wev, "w_name": wnm, "w_pred": wpr, "w_match": wmt}
+        return new_state, ys
+
+    return advance
+
+
+def build_pallas_batched_post(query: CompiledQuery, config: EngineConfig):
+    """Post pass (pend append + GC) for pallas-layout ys ([T, K, cap])."""
+    from .engine import build_post
+
+    post = build_post(query, config)
+    return jax.jit(jax.vmap(post, in_axes=(-1, -1, 1), out_axes=(-1, -1)))
