@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Runnable end-to-end stock demo: the framework's `CEPStockDemo.main`.
+
+Mirrors the reference demo app (reference:
+example/src/main/java/.../CEPStockDemo.java:52-112): produce the 8 golden
+stock events into a file-backed RecordLog topic, build a topology with the
+SASE SIGMOD'08 rising-stock query, pump it with the LogDriver (restore ->
+poll -> commit), and read the 4 golden JSON matches back off the sink
+topic -- once with the per-record host runtime and once with the
+micro-batching TPU runtime (which falls back to the XLA-on-CPU engine when
+no TPU is present, so the demo runs anywhere).
+
+    python examples/stocks_demo.py [--runtime host|tpu|both] [--dir DIR]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from kafkastreams_cep_tpu import ComplexStreamsBuilder
+from kafkastreams_cep_tpu.models.stocks import (
+    GOLDEN_EVENTS,
+    GOLDEN_MATCHES,
+    stocks_pattern,
+)
+from kafkastreams_cep_tpu.ops.schema import EventSchema
+from kafkastreams_cep_tpu.streams.driver import LogDriver, produce
+from kafkastreams_cep_tpu.streams.log import RecordLog
+from kafkastreams_cep_tpu.streams.serde import Queried, sequence_to_json
+
+
+def run(runtime: str, base_dir: str) -> None:
+    log = RecordLog(path=str(Path(base_dir) / f"cep-demo-{runtime}"))
+    for i, event in enumerate(GOLDEN_EVENTS):
+        produce(log, "StockEvents", "K1", event, timestamp=i)
+
+    builder = ComplexStreamsBuilder(log=log, app_id="stock-demo")
+    kwargs = {}
+    if runtime == "tpu":
+        kwargs = dict(
+            queried=Queried(
+                schema=EventSchema(
+                    {"name": np.int32, "price": np.int32, "volume": np.int32}
+                )
+            ),
+            batch_size=4,
+        )
+    out = (
+        builder.stream("StockEvents")
+        .query("Stocks", stocks_pattern(), runtime=runtime, **kwargs)
+        .to("Matches")
+    )
+    topology = builder.build()
+
+    driver = LogDriver(topology, group="stock-demo")
+    processed = driver.poll()
+    topology.flush()
+    driver.commit()
+
+    got = [sequence_to_json(r.value) for r in out.records]
+    sink = [r for r in log.read("Matches")]
+    print(f"[{runtime}] processed {processed} events, "
+          f"{len(got)} matches, {len(sink)} sink records:")
+    for line in got:
+        print(f"  {line}")
+    assert got == GOLDEN_MATCHES, "output diverged from the golden matches!"
+    assert len(sink) == len(GOLDEN_MATCHES)
+    print(f"[{runtime}] OK -- exact golden output "
+          f"(CEPStockDemoTest.java:101-109)")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--runtime", default="both",
+                    choices=["host", "tpu", "both"])
+    ap.add_argument("--dir", default=None,
+                    help="RecordLog directory (default: a temp dir)")
+    args = ap.parse_args()
+    runtimes = ["host", "tpu"] if args.runtime == "both" else [args.runtime]
+    if args.dir is not None:
+        for rt in runtimes:
+            run(rt, args.dir)
+        return
+    with tempfile.TemporaryDirectory() as tmp:
+        for rt in runtimes:
+            run(rt, tmp)
+
+
+if __name__ == "__main__":
+    main()

@@ -31,7 +31,7 @@ PyObject* field_of(PyObject* value, PyObject* name, bool scalar) {
     Py_INCREF(value);
     return value;
   }
-  if (PyDict_Check(value)) {
+  if (PyDict_CheckExact(value)) {
     PyObject* item = PyDict_GetItemWithError(value, name);  // borrowed
     if (item == nullptr) {
       if (!PyErr_Occurred()) {
@@ -41,6 +41,11 @@ PyObject* field_of(PyObject* value, PyObject* name, bool scalar) {
     }
     Py_INCREF(item);
     return item;
+  }
+  if (PyDict_Check(value)) {
+    // dict subclass: honor an overridden __getitem__, as the Python
+    // packer's value[name] does.
+    return PyObject_GetItem(value, name);
   }
   return PyObject_GetAttr(value, name);
 }
@@ -53,15 +58,18 @@ long token_of(PyObject* vocab, PyObject* rev, PyObject* value) {
     return PyLong_AsLong(code);
   }
   if (PyErr_Occurred()) return -1;
+  // Append to rev FIRST: if the dict insert then fails we can roll the
+  // list back, so vocab and rev_vocab can never diverge (a divergence
+  // would make later decodes of the interned code return the wrong value).
   Py_ssize_t next = PyList_GET_SIZE(rev);
+  if (PyList_Append(rev, value) < 0) return -1;
   PyObject* next_obj = PyLong_FromSsize_t(next);
-  if (next_obj == nullptr) return -1;
-  if (PyDict_SetItem(vocab, value, next_obj) < 0) {
-    Py_DECREF(next_obj);
+  if (next_obj == nullptr || PyDict_SetItem(vocab, value, next_obj) < 0) {
+    Py_XDECREF(next_obj);
+    if (PySequence_DelItem(rev, next) < 0) PyErr_Clear();
     return -1;
   }
   Py_DECREF(next_obj);
-  if (PyList_Append(rev, value) < 0) return -1;
   return static_cast<long>(next);
 }
 
@@ -182,8 +190,19 @@ PyObject* pack_batch(PyObject*, PyObject* args) {
       if (!fail) {
         long long ts_v = PyLong_AsLongLong(ts);
         if (ts_v == -1 && PyErr_Occurred()) {
-          fail = true;
-        } else {
+          // schema.pack coerces via int(t): accept float (and other
+          // __index__/__int__-bearing) timestamps identically.
+          PyErr_Clear();
+          PyObject* ts_int = PyNumber_Long(ts);
+          if (ts_int == nullptr) {
+            fail = true;
+          } else {
+            ts_v = PyLong_AsLongLong(ts_int);
+            Py_DECREF(ts_int);
+            if (ts_v == -1 && PyErr_Occurred()) fail = true;
+          }
+        }
+        if (!fail) {
           ts_data[at] = static_cast<int32_t>(ts_v - ts_base);
         }
       }

@@ -151,8 +151,14 @@ def init_pool(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndarr
     Node ids < config.nodes index this compacted region; ids >= config.nodes
     index the current advance's time-indexed window (the scan's stacked
     outputs) until the post-advance GC folds the window back into the
-    region. `pend` holds emitted match ids (GC roots, remapped on compaction)
-    until the host drains them.
+    region.
+
+    `pend` is a *paged* pending-match buffer: each advance appends its whole
+    [T * matches_per_step] match-id page at `pend_pos` (one uniform-offset
+    dynamic slice -- O(page), not O(ring), and no per-key scatter), holes
+    kept as -1. `pinned` marks region nodes reachable from already-appended
+    pages so the per-advance GC mark walk only has to traverse the *new*
+    page's chains (frontier O(lanes + page), independent of the ring size).
     """
     B = config.nodes
     M = config.matches
@@ -163,6 +169,8 @@ def init_pool(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndarr
         "node_count": jnp.asarray(0, jnp.int32),
         "pend": jnp.full(M, -1, jnp.int32),
         "pend_count": jnp.asarray(0, jnp.int32),
+        "pend_pos": jnp.asarray(0, jnp.int32),
+        "pinned": jnp.zeros(B, bool),
     }
 
 
@@ -721,89 +729,212 @@ def build_step(
     return step
 
 
-def build_post(query: CompiledQuery, config: EngineConfig):
-    """The post-advance device pass: pend-append + mark-sweep GC (one key).
+def build_pend_append(config: EngineConfig):
+    """The unvmapped pend-page append: one uniform-offset dynamic slice.
+
+    Works on single-key ([M]) and batched K-last ([M, K]) pools alike --
+    the page offset is the *same* for every key (each advance appends a
+    fixed-size [T * matches_per_step] page, holes as -1), so the append
+    never needs a per-key dynamic offset (a serialized scatter on TPU) and
+    costs O(page), independent of the ring size.
+
+    Returns (state', pool', page_roots): page_roots is the appended page
+    ([TM] or [TM, K]) with the whole page blanked to -1 when it did not
+    fit -- the GC must only pin chains of ids that actually landed in the
+    ring. A rejected page's valid ids are counted into match_drops (the
+    loud failure mode; BatchedDeviceNFA.auto_drain prevents this by
+    draining before `pend_pos + TM` can exceed the ring).
+    """
+    M = config.matches
+    M_STEP = config.matches_per_step
+
+    def append_compact(
+        state: Dict[str, jnp.ndarray],
+        pool: Dict[str, jnp.ndarray],
+        ids: jnp.ndarray,  # [TM] or [TM, K]
+    ):
+        """Fallback when a page exceeds the ring (TM > M): sort the page's
+        valid ids to the front and place them at each key's own `pend_pos`
+        cursor (no new holes). O(ring) per advance plus a page sort --
+        fine for the single-key runtime and odd batch shapes; the paged
+        path below is the fast one. Both modes share the hole-inclusive
+        `pend_pos` cursor, so they compose on one pool (the device
+        processor flushes variable-length partial batches)."""
+        TM = ids.shape[0]
+        m_valid = ids >= 0
+        pos = pool["pend_pos"]
+        order = jnp.argsort(~m_valid, axis=0, stable=True)
+        m_sorted = jnp.take_along_axis(ids, order, axis=0)
+        n_m = jnp.sum(m_valid.astype(jnp.int32), axis=0)
+        rank = jnp.cumsum(m_valid.astype(jnp.int32), axis=0) - 1
+        idx = jnp.arange(M).reshape((M,) + (1,) * (ids.ndim - 1))
+        rel = idx - pos
+        take = (rel >= 0) & (rel < TM) & (rel < n_m)
+        gathered = jnp.take_along_axis(
+            m_sorted, jnp.broadcast_to(rel.clip(0, TM - 1), (M,) + ids.shape[1:]),
+            axis=0,
+        )
+        new_pend = jnp.where(take, gathered, pool["pend"])
+        placed = jnp.minimum(jnp.maximum(M - pos, 0), n_m)
+        drops = n_m - placed
+        new_pool = {
+            **pool,
+            "pend": new_pend,
+            "pend_count": pool["pend_count"] + placed,
+            "pend_pos": (pos + placed).astype(jnp.int32),
+        }
+        new_state = {
+            **state,
+            "match_drops": state["match_drops"] + drops,
+        }
+        page_roots = jnp.where(m_valid & (pos + rank < M), ids, -1)
+        return new_state, new_pool, page_roots
+
+    def append(
+        state: Dict[str, jnp.ndarray],
+        pool: Dict[str, jnp.ndarray],
+        w_match: jnp.ndarray,  # [T, M_STEP] or [T, M_STEP, K]
+    ):
+        T = w_match.shape[0]
+        TM = T * M_STEP
+        rest = w_match.shape[2:]
+        ids = w_match.reshape((TM,) + rest)
+        if TM > M or not rest:
+            # Oversized pages can't ride the ring; and the single-key pool
+            # ([M], no key axis) always compacts -- hole pages would shrink
+            # its deferred-decode capacity from `matches` matches to
+            # matches/page pages, and at K=1 the compact path's sort and
+            # O(M) placement are trivial anyway.
+            return append_compact(state, pool, ids)
+        pend = pool["pend"]
+        pos_leaf = pool["pend_pos"]
+        # Page start: the max cursor across keys. After paged appends the
+        # cursor is uniform; after a compact append it may be ragged --
+        # starting at the max never clobbers any key's entries and keeps
+        # position order == emission order.
+        pos0 = jnp.max(pos_leaf) if pos_leaf.ndim else pos_leaf
+        fits = pos0 + TM <= M
+        start = jnp.minimum(pos0, M - TM)
+        zeros = (0,) * len(rest)
+        cur = jax.lax.dynamic_slice(
+            pend, (start,) + zeros, (TM,) + pend.shape[1:]
+        )
+        page = jnp.where(fits, ids, cur)
+        new_pend = jax.lax.dynamic_update_slice(pend, page, (start,) + zeros)
+        n_valid = jnp.sum((ids >= 0).astype(jnp.int32), axis=0)  # [K] or ()
+        added = jnp.where(fits, n_valid, 0)
+        # All-hole pages (no key matched this advance -- the common case in
+        # sparse CEP) must not consume ring capacity.
+        any_valid = jnp.sum(n_valid) > 0
+        new_pool = {
+            **pool,
+            "pend": new_pend,
+            "pend_count": pool["pend_count"] + added,
+            "pend_pos": jnp.broadcast_to(
+                jnp.where(fits & any_valid, pos0 + TM, pos0), pos_leaf.shape
+            ).astype(jnp.int32),
+        }
+        new_state = {
+            **state,
+            "match_drops": state["match_drops"] + (n_valid - added),
+        }
+        page_roots = jnp.where(fits, ids, -1)
+        return new_state, new_pool, page_roots
+
+    return append
+
+
+def build_gc(query: CompiledQuery, config: EngineConfig):
+    """The per-key post-advance GC: pin-seeded mark + sweep compaction.
 
     Runs once per advance (not per event step):
 
-      1. append the advance's emitted match ids (ys["w_match"]) to the
-         pool's pending buffer -- pending matches are GC *roots*, so their
-         chains survive compaction and their ids are remapped with it
-         (decode after GC is always id-consistent);
-      2. mark every node reachable from live lanes or pending matches.
-         The walk is scatter-free: the frontier (lane heads + pend ids) is
-         re-sorted each hop and membership is a vectorized searchsorted
-         against all node ids -- no per-key serialized scatters;
-      3. compact marked nodes from (region + this advance's time-indexed
+      1. mark every node reachable from live lanes or this advance's
+         pend page. The mark is seeded with the region's `pinned` bitmap
+         (nodes kept alive by *earlier* pages), so the frontier is only
+         [lanes + page] wide -- independent of the pend ring size -- and
+         chains already pinned terminate the walk after one hop;
+      2. compact marked nodes from (region + this advance's time-indexed
          window) into a fresh region of `config.nodes` slots via one stable
-         argsort + gathers, remapping lane pointers, node preds and pend
-         ids. Region overflow drops newest chains (node_drops).
+         argsort + gathers, remapping lane pointers, node preds, the whole
+         pend ring and the pinned bitmap. Region overflow drops newest
+         chains (node_drops).
 
     The host analog of the reference's refcount GC
     (SharedVersionedBufferStoreImpl.java:176-201). vmap over the trailing
     key axis for the multi-key engine (key_shard.build_batched_post).
+    Note: `pinned` over-approximates pend-reachability with *all* marked
+    nodes, so lane chains whose runs die stay resident until the next
+    drain clears the pins -- bounded garbage traded for the O(page)
+    frontier.
     """
     B = config.nodes
-    M = config.matches
     R = config.lanes
-    L = query.max_depth
-    M_STEP = config.matches_per_step
 
-    def post(
+    def gc(
         state: Dict[str, jnp.ndarray],
         pool: Dict[str, jnp.ndarray],
         ys: Dict[str, jnp.ndarray],
+        page_roots: jnp.ndarray,  # [TM]
     ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
         T, p_cap = ys["w_event"].shape
         W = T * p_cap
         w_event = ys["w_event"].reshape(W)
         w_name = ys["w_name"].reshape(W)
         w_pred = ys["w_pred"].reshape(W)
+        pend = pool["pend"]
 
-        # -- 1. append match ids to the pending buffer (gather-based) --------
-        TM = T * M_STEP
-        m_ids = ys["w_match"].reshape(TM)
-        m_valid = m_ids >= 0
-        n_m = jnp.sum(m_valid).astype(jnp.int32)
-        m_sorted = m_ids[jnp.argsort(~m_valid, stable=True)]  # emission order
-        pc = pool["pend_count"]
-        idx = jnp.arange(M)
-        rel = idx - pc
-        take = (rel >= 0) & (rel < TM) & (rel < n_m)
-        pend = jnp.where(take, m_sorted[rel.clip(0, TM - 1)], pool["pend"])
-        new_pc = jnp.minimum(pc + n_m, M)
-        pend_drops = jnp.maximum(pc + n_m - M, 0)
-
-        # -- 2. mark reachable nodes (frontier walk) -------------------------
-        # The frontier advances one predecessor hop per iteration; marking
-        # uses a small scatter over [R + M] indices (measured cheaper on TPU
-        # than sort+searchsorted membership at these widths). Dead cursors
-        # route to a trash slot so their writes can't clobber id 0.
+        # -- 1. mark reachable nodes (chunked frontier walk) -----------------
+        # Each walk advances its frontier one predecessor hop per iteration;
+        # marking uses a small scatter over the chunk's indices (measured
+        # cheaper on TPU than sort+searchsorted membership at these widths).
+        # Dead cursors route to a trash slot so their writes can't clobber
+        # id 0. The page roots are mostly holes (-1), so the page is
+        # reordered slot-major -- each step's w_match block is a valid
+        # prefix, so slot-major concentrates real ids in the first chunk --
+        # and walked in fixed-width chunks: an all-dead chunk's while_loop
+        # exits after a single cond reduce, keeping the per-hop scatter
+        # width O(chunk), not O(T * matches_per_step).
         BW = B + W
         combined_pred = jnp.concatenate([pool["node_pred"], w_pred])
         lane_roots = jnp.where(state["active"], state["node"], -1)
-        pend_roots = jnp.where(jnp.arange(M) < new_pc, pend, -1)
-        frontier0 = jnp.concatenate([lane_roots, pend_roots])  # [R + M]
-
-        def cond(carry):
-            _, fr = carry
-            return jnp.any(fr >= 0)
-
-        def body(carry):
-            marked, fr = carry
-            live = fr >= 0
-            cidx = jnp.where(live, fr, BW)  # BW = trash slot
-            already = marked[cidx] & live
-            marked = marked.at[cidx].set(True)
-            fr = jnp.where(live & ~already, combined_pred[cidx.clip(0, BW - 1)], -1)
-            return marked, fr
-
-        marked, _ = jax.lax.while_loop(
-            cond, body, (jnp.zeros(BW + 1, bool), frontier0)
+        marked0 = jnp.concatenate(
+            [pool["pinned"], jnp.zeros(W + 1, bool)]
         )
+
+        def walk(marked, frontier):
+            def cond(carry):
+                _, fr = carry
+                return jnp.any(fr >= 0)
+
+            def body(carry):
+                mk, fr = carry
+                live = fr >= 0
+                cidx = jnp.where(live, fr, BW)  # BW = trash slot
+                already = mk[cidx] & live
+                mk = mk.at[cidx].set(True)
+                fr = jnp.where(
+                    live & ~already, combined_pred[cidx.clip(0, BW - 1)], -1
+                )
+                return mk, fr
+
+            marked, _ = jax.lax.while_loop(cond, body, (marked, frontier))
+            return marked
+
+        marked = walk(marked0, lane_roots)
+        TM_page = page_roots.shape[0]
+        m_step = max(config.matches_per_step, 1)
+        if TM_page % m_step == 0 and TM_page > m_step:
+            # [T * M_STEP] t-major -> slot-major (valid-dense prefix).
+            page_sm = page_roots.reshape(-1, m_step).T.reshape(TM_page)
+        else:
+            page_sm = page_roots
+        CHUNK = 256
+        for c0 in range(0, TM_page, CHUNK):
+            marked = walk(marked, page_sm[c0 : c0 + CHUNK])
         marked = marked[:BW]
 
-        # -- 3. compact into a fresh region [B] ------------------------------
+        # -- 2. compact into a fresh region [B] ------------------------------
         n_keep = jnp.sum(marked).astype(jnp.int32)
         rank = _excl_cumsum(marked)
         remap = jnp.where(marked & (rank < B), rank, -1).astype(jnp.int32)
@@ -824,7 +955,9 @@ def build_post(query: CompiledQuery, config: EngineConfig):
             "node_pred": jnp.where(ok, pred_remapped[sel], -1),
             "node_count": jnp.minimum(n_keep, B),
             "pend": jnp.where(pend >= 0, remap_full[pend.clip(0)], -1),
-            "pend_count": new_pc,
+            "pend_count": pool["pend_count"],
+            "pend_pos": pool["pend_pos"],
+            "pinned": marked[sel] & ok,
         }
         new_state = {
             **state,
@@ -833,19 +966,41 @@ def build_post(query: CompiledQuery, config: EngineConfig):
             ).astype(jnp.int32),
             "node_drops": state["node_drops"]
             + jnp.maximum(n_keep - B, 0).astype(jnp.int32),
-            "match_drops": state["match_drops"] + pend_drops.astype(jnp.int32),
         }
         return new_state, new_pool
+
+    return gc
+
+
+def build_post(query: CompiledQuery, config: EngineConfig):
+    """Single-key post pass: pend-page append + pin-seeded mark-sweep GC."""
+    append = build_pend_append(config)
+    gc = build_gc(query, config)
+
+    def post(
+        state: Dict[str, jnp.ndarray],
+        pool: Dict[str, jnp.ndarray],
+        ys: Dict[str, jnp.ndarray],
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        state, pool, page_roots = append(state, pool, ys["w_match"])
+        return gc(state, pool, ys, page_roots)
 
     return post
 
 
 def drain_pend(pool: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
-    """Clear the pending-match buffer (jit-able; keeps shardings)."""
+    """Clear the pending-match buffer (jit-able; keeps shardings).
+
+    Also clears `pinned`: pins exist solely to keep pending matches' chains
+    alive across GC passes, so the next post pass rebuilds reachability
+    from live lanes alone and pin-retained garbage is collected then.
+    """
     return {
         **pool,
         "pend": jnp.full_like(pool["pend"], -1),
         "pend_count": jnp.zeros_like(pool["pend_count"]),
+        "pend_pos": jnp.zeros_like(pool["pend_pos"]),
+        "pinned": jnp.zeros_like(pool["pinned"]),
     }
 
 

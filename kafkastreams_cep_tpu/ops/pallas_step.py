@@ -639,7 +639,6 @@ def build_pallas_batched_advance(
         for s, m in zip(slots, ns_masks):
             s["seq"] = jnp.where(m, runs + 1 + ns_off + partial, s["seq"])
             partial = partial + m.astype(jnp.int32)
-        new_runs = runs + n_new
 
         # ==== match extraction + lane compaction (engine.py:645-679) ========
         match_masks = [s["occ"] & s["match"] for s in slots]
@@ -864,8 +863,21 @@ def build_pallas_batched_advance(
 
 
 def build_pallas_batched_post(query: CompiledQuery, config: EngineConfig):
-    """Post pass (pend append + GC) for pallas-layout ys ([T, K, cap])."""
-    from .engine import build_post
+    """Post pass (pend-page append + GC) for pallas-layout ys ([T, K, cap])."""
+    from .engine import build_gc, build_pend_append
 
-    post = build_post(query, config)
-    return jax.jit(jax.vmap(post, in_axes=(-1, -1, 1), out_axes=(-1, -1)))
+    append = build_pend_append(config)
+    gc = jax.vmap(
+        build_gc(query, config), in_axes=(-1, -1, 1, -1), out_axes=(-1, -1)
+    )
+
+    @jax.jit
+    def post(state, pool, ys):
+        # w_match arrives [T, K, M_STEP]; the append wants the key axis
+        # last ([T, M_STEP, K]) so its page reshape stays t-major.
+        state, pool, page_roots = append(
+            state, pool, jnp.transpose(ys["w_match"], (0, 2, 1))
+        )
+        return gc(state, pool, ys, page_roots)
+
+    return post

@@ -178,8 +178,14 @@ class DeviceNFA:
     def _decode_matches(self) -> List[Sequence]:
         count = int(self.pool["pend_count"])
         if count == 0:
+            if int(self.pool["pend_pos"]) > 0:
+                self.pool = self._drain_pend(self.pool)  # reclaim hole pages
             return []
-        pend = np.asarray(self.pool["pend"])[:count]
+        # The pend ring is paged with -1 holes; valid ids in [0, pend_pos)
+        # are in emission order (page append order, t-major within a page).
+        pos = int(self.pool["pend_pos"])
+        pend = np.asarray(self.pool["pend"])[:pos]
+        pend = pend[pend >= 0]
         node_event = np.asarray(self.pool["node_event"])
         node_name = np.asarray(self.pool["node_name"])
         node_pred = np.asarray(self.pool["node_pred"])

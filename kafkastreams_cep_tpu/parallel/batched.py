@@ -53,6 +53,13 @@ class BatchedDeviceNFA:
     driver layer above assigns keys to lanes; see streams/device_processor).
     With `mesh` set, engine state and event columns shard along the key axis
     over the mesh's devices.
+
+    `engine` selects the transition kernel: "auto" (default) runs the fused
+    Pallas kernel (ops/pallas_step.py) on single-chip TPU and the vmapped
+    XLA scan step everywhere else (mesh-sharded, CPU, configs outside the
+    kernel envelope -- the reason lands in `engine_fallback_reason`);
+    "xla" / "pallas" force a path; "pallas_interpret" runs the kernel in
+    the Pallas interpreter (conformance tests on CPU).
     """
 
     def __init__(
@@ -63,6 +70,8 @@ class BatchedDeviceNFA:
         config: Optional[EngineConfig] = None,
         mesh: Optional[Any] = None,
         events_prune_threshold: int = 1 << 16,
+        engine: str = "auto",
+        auto_drain: bool = True,
     ) -> None:
         if isinstance(stages_or_query, CompiledQuery):
             self.query = stages_or_query
@@ -74,14 +83,12 @@ class BatchedDeviceNFA:
         self.keys: List[Any] = list(keys)
         if not self.keys:
             raise ValueError("BatchedDeviceNFA needs at least one key")
+        self.engine, self.engine_fallback_reason = self._pick_engine(engine)
         # Pad the key axis to a multiple of the mesh extent so the shard is
-        # even; padding lanes never receive valid events.
+        # even (and of the pallas kernel's 8-key block); padding lanes never
+        # receive valid events.
         self.K = len(self.keys)
-        k_pad = self.K
-        if mesh is not None:
-            n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
-            k_pad = ((self.K + n_dev - 1) // n_dev) * n_dev
-        self.K_padded = k_pad
+        self.K_padded = self._padded_extent(self.K)
         self.key_index: Dict[Any, int] = {k: i for i, k in enumerate(self.keys)}
 
         self.state = init_batched_state(self.query, self.config, self.K_padded)
@@ -89,11 +96,35 @@ class BatchedDeviceNFA:
         if mesh is not None:
             self.state = shard_state(self.state, mesh)
             self.pool = shard_state(self.pool, mesh)
-        self._advance = build_batched_advance(self.query, self.config)
-        self._post = build_batched_post(self.query, self.config)
+        if self.engine.startswith("pallas"):
+            from ..ops.pallas_step import (
+                build_pallas_batched_advance,
+                build_pallas_batched_post,
+            )
+
+            self._advance = build_pallas_batched_advance(
+                self.query, self.config,
+                interpret=(self.engine == "pallas_interpret"),
+            )
+            self._post = build_pallas_batched_post(self.query, self.config)
+        else:
+            self._advance = build_batched_advance(self.query, self.config)
+            self._post = build_batched_post(self.query, self.config)
         self._drain_pend = jax.jit(drain_pend)
         # post (pend-append + GC) runs every advance: node ids are only
         # stable across advances through its remap.
+        #: Capacity guard against silent match loss (the reference never
+        #: drops a match, SharedVersionedBufferStoreImpl.java:101-126): a
+        #: non-decoding advance can append at most T * matches_per_step ids
+        #: per key, so draining whenever the worst-case running total would
+        #: exceed the pend ring keeps overflow impossible -- with zero
+        #: device syncs until a drain is actually forced. Auto-drained
+        #: matches are buffered host-side and handed out by the next
+        #: explicit drain()/decoding advance.
+        self.auto_drain = auto_drain
+        self._pend_accum = 0
+        self._auto_buffer: Dict[Any, List[Sequence]] = {}
+        self._compact_pend_fn = None
         self.events_prune_threshold = events_prune_threshold
         self._events: Dict[int, Event] = {}
         self._next_gidx = 0
@@ -112,6 +143,47 @@ class BatchedDeviceNFA:
         #: (SURVEY.md §5.5; semantics in ops/profiling.py).
         self.timings = BatchTimings()
 
+    def _pick_engine(self, engine: str) -> Tuple[str, Optional[str]]:
+        """Resolve "auto" to the fused pallas kernel when it applies.
+
+        The kernel runs single-chip only (a mesh shards the XLA path);
+        "auto" keeps the XLA scan step for meshes, non-TPU platforms and
+        configs outside the kernel's envelope, recording why in
+        `engine_fallback_reason`.
+        """
+        from ..ops.pallas_step import supports_pallas
+
+        if engine in ("xla", "pallas", "pallas_interpret"):
+            if engine.startswith("pallas"):
+                reason = supports_pallas(self.query, self.config)
+                if reason is not None:
+                    raise ValueError(f"pallas engine unsupported: {reason}")
+                if self.mesh is not None:
+                    raise ValueError(
+                        "pallas engine does not shard over a mesh yet; "
+                        "use engine='xla' with mesh"
+                    )
+            return engine, None
+        if engine != "auto":
+            raise ValueError(f"unknown engine {engine!r}")
+        if self.mesh is not None:
+            return "xla", "mesh-sharded run"
+        platform = jax.devices()[0].platform
+        if platform != "tpu":
+            return "xla", f"platform {platform!r} (pallas kernel is TPU-only)"
+        reason = supports_pallas(self.query, self.config)
+        if reason is not None:
+            return "xla", reason
+        return "pallas", None
+
+    def _padded_extent(self, k: int) -> int:
+        mult = 1
+        if self.mesh is not None:
+            mult = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
+        if self.engine.startswith("pallas"):
+            mult = max(mult, 8)  # kernel key-block granularity
+        return ((k + mult - 1) // mult) * mult
+
     # ------------------------------------------------------------------ API
     def add_keys(self, new_keys: Seq[Any]) -> None:
         """Grow the key axis: fresh per-key engine state for each new key.
@@ -124,10 +196,7 @@ class BatchedDeviceNFA:
                 raise KeyError(f"key {k!r} already assigned")
         self.keys.extend(new_keys)
         self.K = len(self.keys)
-        k_pad = self.K
-        if self.mesh is not None:
-            n_dev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
-            k_pad = ((self.K + n_dev - 1) // n_dev) * n_dev
+        k_pad = self._padded_extent(self.K)
         delta = k_pad - self.K_padded
         self.key_index = {k: i for i, k in enumerate(self.keys)}
         if delta > 0:
@@ -190,6 +259,8 @@ class BatchedDeviceNFA:
                 min_first = ts0 if min_first is None else min(min_first, ts0)
         if T == 0 or min_first is None:
             raise ValueError("empty batch")
+        gidx_before = self._next_gidx
+        ts_base_before = self._ts_base
         if self._ts_base is None:
             # Shared rebase across ALL keys: take the min first-timestamp in
             # this batch minus a margin, so a key whose stream starts
@@ -259,8 +330,15 @@ class BatchedDeviceNFA:
 
         # Complete rebase-underflow guard: covers out-of-order events deep
         # inside a batch and late batches alike (one vectorized pass;
-        # padding slots hold 0 and cannot mask a real negative).
+        # padding slots hold 0 and cannot mask a real negative). The
+        # registry/gidx/base mutations above are rolled back so a caller
+        # that catches and skips the bad batch leaks nothing (interned
+        # schema vocab tokens may leak ids -- append-only and harmless).
         if int(cols["ts"].min()) < 0:
+            for g in range(gidx_before, self._next_gidx):
+                self._events.pop(g, None)
+            self._next_gidx = gidx_before
+            self._ts_base = ts_base_before
             raise ValueError(
                 f"event timestamp rebases negative (base {self._ts_base}, "
                 f"margin {TS_REBASE_MARGIN_MS} ms): an event arrived more "
@@ -293,6 +371,29 @@ class BatchedDeviceNFA:
         decoding advance. Size `EngineConfig.matches` for the accumulation
         window; overflow shows up in `stats["match_drops"]`.
         """
+        T = int(xs["valid"].shape[0])
+        step_cap = T * self.config.matches_per_step
+        raw = None
+        # The capacity guard only applies in the paged-append regime
+        # (step_cap <= matches): there the worst-case cursor growth is
+        # exactly one page per matching advance and a pre-advance drain
+        # makes ring overflow impossible. With step_cap > matches the
+        # engine's compact append places what fits and counts the rest in
+        # match_drops (loud) -- size EngineConfig.matches to at least one
+        # page (T * matches_per_step) for loss-free deferred decode.
+        if (
+            self.auto_drain
+            and step_cap <= self.config.matches
+            and self._pend_accum + step_cap > self.config.matches
+        ):
+            # Ring would overflow in the worst case: pull the pending
+            # matches off the device and clear the ring NOW, but decode
+            # them host-side only after the next advance is dispatched --
+            # the Python materialization then overlaps device compute.
+            # Applies to decoding advances too: their own drain only runs
+            # after the advance has already appended to the ring.
+            raw = self._pull_raw()
+            self._pend_accum = 0
         if self._pack_hwms:
             self._processed_gidx = max(
                 self._processed_gidx, self._pack_hwms.popleft()
@@ -303,12 +404,16 @@ class BatchedDeviceNFA:
         self.state, ys = self._advance(self.state, xs)
         self.state, self.pool = self._post(self.state, self.pool, ys)
         self._batches += 1
+        self._pend_accum += step_cap
         # Slot count from shape only -- counting true valids would pull the
         # device array and break the zero-sync advance path (exact event
         # totals live in the engine's n_events counter).
         self.timings.record_advance(
             _time.perf_counter() - t0, int(np.prod(xs["valid"].shape))
         )
+        if raw is not None:
+            for k, v in self._decode_raw(raw).items():
+                self._auto_buffer.setdefault(k, []).extend(v)
         out: Dict[Any, List[Sequence]] = {}
         if decode:
             out = self.drain()
@@ -322,14 +427,17 @@ class BatchedDeviceNFA:
         import time as _time
 
         t0 = _time.perf_counter()
-        counts = np.asarray(self.pool["pend_count"])  # [K] (1-D; K-last = K-only)
-        self.last_match_counts = counts
+        self._pend_accum = 0
+        buffered = self._auto_buffer
+        self._auto_buffer = {}
+        raw = self._pull_raw()
+        out = buffered
+        if raw is not None:
+            for k, v in self._decode_raw(raw).items():
+                out.setdefault(k, []).extend(v)
+        # Prune AFTER decoding: the raw snapshot's chains reference events
+        # by gidx, and materialized Sequences hold the Event objects.
         self._prune_events()  # registry must stay bounded on match-free streams
-        if counts.sum() == 0:
-            self.timings.record_drain(_time.perf_counter() - t0, 0)
-            return {}
-        out = self._decode_matches(counts)
-        self.pool = self._drain_pend(self.pool)
         self.timings.record_drain(
             _time.perf_counter() - t0, sum(len(v) for v in out.values())
         )
@@ -366,6 +474,7 @@ class BatchedDeviceNFA:
         schema: Optional[EventSchema] = None,
         config: Optional[EngineConfig] = None,
         mesh: Optional[Any] = None,
+        engine: str = "auto",
     ) -> "BatchedDeviceNFA":
         import pickle
 
@@ -381,7 +490,8 @@ class BatchedDeviceNFA:
             raise ValueError("bad checkpoint magic")
         keys = pickle.loads(r.blob())
         bat = cls(
-            stages_or_query, keys=keys, schema=schema, config=config, mesh=mesh,
+            stages_or_query, keys=keys, schema=schema, config=config,
+            mesh=mesh, engine=engine,
         )
         tree = decode_array_tree(r.blob())
         state = {k: jnp.asarray(v) for k, v in tree.items()}
@@ -393,9 +503,30 @@ class BatchedDeviceNFA:
         bat.state = state
         bat.pool = pool
         bat.K_padded = int(tree["active"].shape[-1])
+        # A checkpoint taken under a different engine may carry a key-axis
+        # extent off this engine's granularity (pallas advances 8-key
+        # blocks); grow with fresh padding state, never shrink.
+        want = bat._padded_extent(bat.K_padded)
+        if want > bat.K_padded:
+            delta = want - bat.K_padded
+            cat = lambda old, new: jnp.concatenate([old, new], axis=-1)
+            bat.state = jax.tree.map(
+                cat, bat.state, init_batched_state(bat.query, bat.config, delta)
+            )
+            bat.pool = jax.tree.map(
+                cat, bat.pool, init_batched_pool(bat.query, bat.config, delta)
+            )
+            bat.K_padded = want
+            if mesh is not None:
+                bat.state = shard_state(bat.state, mesh)
+                bat.pool = shard_state(bat.pool, mesh)
         bat._events = decode_event_registry(r.blob())
         bat._next_gidx = r.i64()
         bat._processed_gidx = bat._next_gidx - 1  # no pre-packed xs survive
+        # The restored pool may hold pending undrained matches: seed the
+        # capacity guard with the ring cursor (page occupancy, holes
+        # included) so auto-drain cannot undercount after a restore.
+        bat._pend_accum = int(np.asarray(bat.pool["pend_pos"]).max())
         ts_base = r.i64()
         bat._ts_base = None if ts_base < 0 else ts_base
         bat._batches = r.i64()
@@ -421,29 +552,62 @@ class BatchedDeviceNFA:
         self._native_mod = mod
         return mod
 
-    def _decode_matches(self, counts: np.ndarray) -> Dict[Any, List[Sequence]]:
-        # Bucketed pulls: the compacted region only holds `node_count` live
-        # nodes per key (post-GC ids are dense from 0), so the dominant D2H
-        # transfer is sliced to the max live count, rounded up to a power of
-        # two to bound the number of distinct sliced programs to O(log B)
-        # (PERF.md round-3 lever 3: decode pull width).
+    def _pull_raw(self) -> Optional[Dict[str, np.ndarray]]:
+        """Pull pending matches + the node pools off the device and clear
+        the ring (a sync point). Decode happens separately (`_decode_raw`)
+        so callers can overlap the Python materialization with the next
+        dispatched batch. Returns None when nothing is pending.
+
+        Bucketed pulls: the compacted region only holds `node_count` live
+        nodes per key (post-GC ids are dense from 0), so the dominant D2H
+        transfer is sliced to the max live count, rounded up to a power of
+        two to bound the number of distinct sliced programs to O(log B)
+        (PERF.md round-3 lever 3: decode pull width).
+        """
+        counts = np.asarray(self.pool["pend_count"])  # [K]
+        self.last_match_counts = counts
+        if counts.sum() == 0:
+            if int(np.asarray(self.pool["pend_pos"]).max()) > 0:
+                self.pool = self._drain_pend(self.pool)  # reclaim hole pages
+            return None
         max_nodes = int(np.asarray(self.pool["node_count"]).max())
-        max_pend = int(counts.max())
         full_b = self.pool["node_event"].shape[0]
         full_m = self.pool["pend"].shape[0]
         Bb = 1
         while Bb < max(max_nodes, 1):
             Bb <<= 1
         Bb = min(Bb, full_b)
+        # The paged ring is mostly holes (-1): compact valid ids to a
+        # per-key prefix on-device (one stable sort) so the D2H transfer
+        # is pow2(max per-key count) wide, not pend_pos wide -- the pull
+        # rides a ~100 MB/s tunnel, so bytes are the cost (PERF.md).
+        if self._compact_pend_fn is None:
+            self._compact_pend_fn = jax.jit(
+                lambda p: jnp.take_along_axis(
+                    p, jnp.argsort(p < 0, axis=0, stable=True), axis=0
+                )
+            )
+        compacted = self._compact_pend_fn(self.pool["pend"])
         Mb = 1
-        while Mb < max(max_pend, 1):
+        while Mb < max(int(counts.max()), 1):
             Mb <<= 1
         Mb = min(Mb, full_m)
+        raw = {
+            "counts": counts,
+            "pend": np.asarray(compacted[:Mb]).T,                    # [K, Mb]
+            "node_event": np.asarray(self.pool["node_event"][:Bb]).T,  # [K, Bb]
+            "node_name": np.asarray(self.pool["node_name"][:Bb]).T,
+            "node_pred": np.asarray(self.pool["node_pred"][:Bb]).T,
+        }
+        self.pool = self._drain_pend(self.pool)
+        return raw
 
-        pend = np.asarray(self.pool["pend"][:Mb]).T            # [K, Mb]
-        node_event = np.asarray(self.pool["node_event"][:Bb]).T  # [K, Bb]
-        node_name = np.asarray(self.pool["node_name"][:Bb]).T
-        node_pred = np.asarray(self.pool["node_pred"][:Bb]).T
+    def _decode_raw(self, raw: Dict[str, np.ndarray]) -> Dict[Any, List[Sequence]]:
+        """Materialize a pulled snapshot into per-key Sequence lists."""
+        pend = raw["pend"]
+        node_event = raw["node_event"]
+        node_name = raw["node_name"]
+        node_pred = raw["node_pred"]
         K, B = node_event.shape
 
         # Flatten per-key pools into one index space so every chain across
@@ -455,10 +619,13 @@ class BatchedDeviceNFA:
 
         starts: List[int] = []
         match_key: List[int] = []
+        counts = raw["counts"]
         for k in range(K):
-            for j in range(int(counts[k])):
-                nid = int(pend[k, j])
-                starts.append(nid + k * B if nid >= 0 else -1)
+            row = pend[k, : int(counts[k])]
+            for nid in row:
+                # GC-nulled entries (region overflow remapped the id to -1;
+                # node_drops counts them) survive as -1 after compaction.
+                starts.append(int(nid) + k * B if nid >= 0 else -1)
                 match_key.append(k)
         chains = decode_chains(
             np.asarray(starts, np.int64), flat_name, flat_event, flat_pred

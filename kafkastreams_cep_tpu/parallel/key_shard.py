@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.engine import EngineConfig, build_post, build_step, init_pool, init_state
+from ..ops.engine import EngineConfig, build_step, init_pool, init_state
 from ..ops.tables import CompiledQuery
 
 #: Mesh axis name for the key shard (data-parallel axis).
@@ -85,13 +85,22 @@ def build_batched_advance(query: CompiledQuery, config: EngineConfig):
 
 
 def build_batched_post(query: CompiledQuery, config: EngineConfig):
-    """jit-compiled multi-key post pass (pend-append + GC), vmapped over K.
-
-    State, pool and ys leaves all carry the key axis last; the vmap maps
-    every argument over its trailing axis.
+    """jit-compiled multi-key post pass: unvmapped pend-page append (the
+    page offset is uniform across keys, so vmapping it would only manufacture
+    a serialized per-key scatter) + the per-key GC vmapped over the trailing
+    key axis.
     """
-    post = build_post(query, config)
-    return jax.jit(jax.vmap(post, in_axes=(-1, -1, -1), out_axes=(-1, -1)))
+    from ..ops.engine import build_gc, build_pend_append
+
+    append = build_pend_append(config)
+    gc = jax.vmap(build_gc(query, config), in_axes=(-1, -1, -1, -1), out_axes=(-1, -1))
+
+    @jax.jit
+    def post(state, pool, ys):
+        state, pool, page_roots = append(state, pool, ys["w_match"])
+        return gc(state, pool, ys, page_roots)
+
+    return post
 
 
 def key_mesh(n_devices: Optional[int] = None) -> Mesh:

@@ -61,6 +61,10 @@ class LogDriver:
         self.key_de = key_deserializer
         self.value_de = value_deserializer
         self._positions: Dict[Tuple[str, int], int] = {}
+        #: positions as last durably committed -- commit() appends only the
+        #: deltas, so the offsets topic grows with progress, not with the
+        #: commit count (the last-write-wins read tolerates either).
+        self._committed: Dict[Tuple[str, int], int] = {}
         self.restored_records = 0
         if restore:
             self.restored_records = self.topology.restore_stores()
@@ -75,7 +79,9 @@ class LogDriver:
             group, topic, partition = default_deserializer(rec.key)
             if group != self.group:
                 continue
-            self._positions[(topic, partition)] = default_deserializer(rec.value)
+            pos = default_deserializer(rec.value)
+            self._positions[(topic, partition)] = pos
+            self._committed[(topic, partition)] = pos
 
     def commit(self) -> None:
         """Durably record consumer positions after making the state they
@@ -88,13 +94,21 @@ class LogDriver:
         silently skipping records whose effects were lost."""
         self.topology.flush_stores()
         self.log.flush()  # changelog + sink records durable first
-        for (topic, partition), pos in self._positions.items():
+        dirty = {
+            tp: pos
+            for tp, pos in self._positions.items()
+            if self._committed.get(tp) != pos
+        }
+        if not dirty:
+            return
+        for (topic, partition), pos in dirty.items():
             self.log.append(
                 OFFSETS_TOPIC,
                 default_serializer((self.group, topic, partition)),
                 default_serializer(pos),
             )
         self.log.flush()
+        self._committed.update(dirty)
 
     def position(self, topic: str, partition: int = 0) -> int:
         return self._positions.get((topic, partition), 0)

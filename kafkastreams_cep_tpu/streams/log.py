@@ -129,18 +129,22 @@ class RecordLog:
     def read(
         self, topic: str, partition: int = 0, start: int = 0, max_records: Optional[int] = None
     ) -> List[LogRecord]:
-        records = self._records.get((topic, partition), [])
-        end = len(records) if max_records is None else min(len(records), start + max_records)
-        return records[start:end]
+        with self._lock:
+            records = self._records.get((topic, partition), [])
+            end = len(records) if max_records is None else min(len(records), start + max_records)
+            return records[start:end]
 
     def end_offset(self, topic: str, partition: int = 0) -> int:
-        return len(self._records.get((topic, partition), []))
+        with self._lock:
+            return len(self._records.get((topic, partition), []))
 
     def topics(self) -> List[str]:
-        return sorted({t for (t, _p) in self._records})
+        with self._lock:
+            return sorted({t for (t, _p) in self._records})
 
     def partitions(self, topic: str) -> List[int]:
-        return sorted(p for (t, p) in self._records if t == topic)
+        with self._lock:
+            return sorted(p for (t, p) in self._records if t == topic)
 
     def flush(self) -> None:
         with self._lock:

@@ -177,3 +177,45 @@ def test_ts_rebase_guard_rejects_pre_base_events():
     # ...but beyond it the pack refuses rather than corrupting expiry.
     with _pytest.raises(ValueError, match="rebases negative"):
         bat.pack({"b": [Event("b", "B", t0 - TS_REBASE_MARGIN_MS - 10, "t", 0, 1)]})
+
+
+def test_auto_drain_preserves_matches_under_pend_pressure():
+    """The pend ring is a bounded accumulation window; the reference never
+    drops a match (SharedVersionedBufferStoreImpl.java:101-126). auto_drain
+    (default) must sync-drain before the worst-case running total can
+    overflow the ring, so a long non-decoding run loses nothing."""
+    pattern = (
+        QueryBuilder()
+        .select("a").where(value() == "A")
+        .then().select("b").where(value() == "B")
+        .then().select("c").where(value() == "C")
+        .build()
+    )
+    stages = compile_pattern(pattern)
+    keys = ["k0", "k1"]
+    # One 24-slot page per matching 6-event batch; a 48-slot ring would
+    # overflow on the 3rd undrained batch.
+    config = EngineConfig(lanes=8, nodes=256, matches=48, matches_per_step=4)
+    n_batches, T = 6, 6
+    streams = {k: [
+        Event(k, "ABC"[i % 3], TS + i, "t", 0, i) for i in range(T * n_batches)
+    ] for k in keys}
+
+    def run(auto):
+        bat = BatchedDeviceNFA(stages, keys=keys, config=config, auto_drain=auto)
+        for b in range(n_batches):
+            bat.advance_packed(
+                bat.pack({k: s[b * T:(b + 1) * T] for k, s in streams.items()}),
+                decode=False,
+            )
+        out = bat.drain()
+        return out, bat.stats["match_drops"]
+
+    out_on, drops_on = run(True)
+    assert drops_on == 0
+    expect = T * n_batches // 3  # one match per ABC triple
+    assert {k: len(v) for k, v in out_on.items()} == {k: expect for k in keys}
+
+    out_off, drops_off = run(False)
+    assert drops_off > 0  # the loud counter: overflow is visible, not silent
+    assert sum(len(v) for v in out_off.values()) < 2 * expect

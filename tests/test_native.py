@@ -141,3 +141,31 @@ def test_native_pack_throughput_sanity():
     xs = bat.pack(events)
     assert int(np.asarray(xs["valid"]).sum()) == 64 * 32
     assert int(np.asarray(xs["gidx"]).max()) == 64 * 32 - 1
+
+
+def test_native_pack_float_ts_and_dict_subclass_parity():
+    """ADVICE r3: the C packer must coerce float timestamps via int(t) and
+    honor dict-subclass __getitem__ overrides exactly as the Python packer
+    (schema.pack) does."""
+    class LoudDict(dict):
+        def __getitem__(self, key):
+            if key == "price":
+                return 999  # override: the packer must see this, not dict's
+            return dict.__getitem__(self, key)
+
+    def mk(i, ts):
+        return Event(
+            "k1",
+            LoudDict({"name": "s", "price": 100 + i, "volume": 1200}),
+            ts, "t", 0, i,
+        )
+
+    events = [mk(0, 1_000_000.0), mk(1, 1_000_001.7), mk(2, 1_000_003)]
+    batches = [{"k1": events}]
+    nat, pyb, nat_xs, py_xs = _pack_both(_stock_query, batches)
+    for nxs, pxs in zip(nat_xs, py_xs):
+        for name in nxs:
+            np.testing.assert_array_equal(
+                np.asarray(nxs[name]), np.asarray(pxs[name]), err_msg=name
+            )
+    assert np.asarray(nat_xs[0]["f:price"]).ravel()[:3].tolist() == [999] * 3

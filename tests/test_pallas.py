@@ -1,0 +1,179 @@
+"""Conformance: the fused Pallas kernel vs the XLA scan step.
+
+The kernel (ops/pallas_step.py) must implement the *identical* transition
+relation -- same slot table, DFS emission order, counters and drop policy --
+so these tests compare full engine state bitwise after every batch, plus the
+decoded match sequences, across the three pattern families (strict
+contiguity, folds + skip-till-next, skip-till-any + windows). Runs the
+kernel in the Pallas interpreter so the suite stays CPU-only; the same
+kernel compiles for TPU via Mosaic (BatchedDeviceNFA(engine="pallas")).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu import Event, QueryBuilder, Selected, compile_pattern
+from kafkastreams_cep_tpu.ops.engine import EngineConfig
+from kafkastreams_cep_tpu.ops.schema import EventSchema
+from kafkastreams_cep_tpu.ops.tables import compile_query
+from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA
+from kafkastreams_cep_tpu.pattern.expressions import agg, field, value
+from kafkastreams_cep_tpu.streams.serde import sequence_to_json
+
+TS0 = 1_000_000
+
+
+def letters_pattern():
+    return (
+        QueryBuilder()
+        .select("select-A").where(value() == "A")
+        .then().select("select-B").where(value() == "B")
+        .then().select("select-C").where(value() == "C")
+        .build()
+    )
+
+
+def stock_pattern():
+    return (
+        QueryBuilder()
+        .select("stage-1").where(field("volume") > 1000)
+        .fold("avg", field("price"))
+        .then().select("stage-2", Selected.with_skip_til_next_match())
+        .zero_or_more().where(field("price") > agg("avg", default=0))
+        .fold("avg", (agg("avg", default=0) + field("price")) // 2)
+        .fold("volume", field("volume"))
+        .then().select("stage-3", Selected.with_skip_til_next_match())
+        .where(field("volume") < 0.8 * agg("volume", default=0))
+        .within(ms=64)
+        .build()
+    )
+
+
+def skip2_pattern():
+    qb = QueryBuilder()
+    b = qb.select("s0").where(value() == "A").within(ms=16)
+    for i, ch in enumerate("BC", start=1):
+        b = (
+            b.then().select(f"s{i}", Selected.with_skip_til_any_match())
+            .where(value() == ch).within(ms=16)
+        )
+    return b.build()
+
+
+def letters_stream(rng, n):
+    return [Event("K", rng.choice("ABCD"), TS0 + i, "t", 0, i) for i in range(n)]
+
+
+def stock_stream(rng, n):
+    return [
+        Event(
+            "K",
+            {"name": "s", "price": rng.randint(80, 140),
+             "volume": rng.randint(500, 1500)},
+            TS0 + i, "t", 0, i,
+        )
+        for i in range(n)
+    ]
+
+
+CASES = {
+    "letters": (
+        letters_pattern, None, letters_stream,
+        EngineConfig(lanes=8, nodes=128, matches=32, matches_per_step=8,
+                     nodes_per_step=4),
+    ),
+    "stock": (
+        stock_pattern,
+        EventSchema({"name": np.int32, "price": np.int32, "volume": np.int32}),
+        stock_stream,
+        EngineConfig(lanes=32, nodes=512, matches=64, matches_per_step=16,
+                     nodes_per_step=16),
+    ),
+    "skip2": (
+        skip2_pattern, None, letters_stream,
+        EngineConfig(lanes=32, nodes=256, matches=64, matches_per_step=16,
+                     nodes_per_step=16, strict_windows=True),
+    ),
+}
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_pallas_matches_xla_bitwise(case):
+    pattern_fn, schema, stream_fn, config = CASES[case]
+    query = compile_query(compile_pattern(pattern_fn()), schema)
+    K, T, n_batches = 8, 10, 3
+    keys = [f"k{i}" for i in range(K)]
+    bx = BatchedDeviceNFA(query, keys=keys, config=config, engine="xla")
+    bp = BatchedDeviceNFA(
+        query, keys=keys, config=config, engine="pallas_interpret"
+    )
+    rng = random.Random(5)
+    streams = {k: stream_fn(rng, T * n_batches) for k in keys}
+    for b in range(n_batches):
+        chunk = {k: s[b * T : (b + 1) * T] for k, s in streams.items()}
+        ox = bx.advance(chunk)
+        op = bp.advance(chunk)
+        for name in bx.state:
+            assert np.array_equal(
+                np.asarray(bx.state[name]), np.asarray(bp.state[name])
+            ), f"{case} batch {b}: state[{name}] diverged"
+        for name in bx.pool:
+            assert np.array_equal(
+                np.asarray(bx.pool[name]), np.asarray(bp.pool[name])
+            ), f"{case} batch {b}: pool[{name}] diverged"
+        assert set(ox) == set(op), f"{case} batch {b}: matched key sets differ"
+        for k in ox:
+            jx = [sequence_to_json(s) for s in ox[k]]
+            jp = [sequence_to_json(s) for s in op[k]]
+            assert jx == jp, f"{case} batch {b}: matches differ for {k}"
+
+
+def test_engine_auto_falls_back_off_tpu():
+    query = compile_query(compile_pattern(letters_pattern()), None)
+    bat = BatchedDeviceNFA(
+        query, keys=["a", "b"],
+        config=EngineConfig(lanes=8, nodes=128, matches=16), engine="auto",
+    )
+    # The suite runs on the forced CPU mesh: auto must pick the XLA path
+    # and say why.
+    assert bat.engine == "xla"
+    assert "cpu" in (bat.engine_fallback_reason or "")
+
+
+def test_pallas_pads_key_axis_to_blocks():
+    query = compile_query(compile_pattern(letters_pattern()), None)
+    config = EngineConfig(lanes=8, nodes=128, matches=16, nodes_per_step=4)
+    bat = BatchedDeviceNFA(
+        query, keys=[f"k{i}" for i in range(5)], config=config,
+        engine="pallas_interpret",
+    )
+    assert bat.K_padded == 8
+    out = bat.advance(
+        {"k0": [Event("k0", v, TS0 + i, "t", 0, i)
+                for i, v in enumerate("ABC")]}
+    )
+    assert len(out.get("k0", [])) == 1
+
+
+def test_pallas_checkpoint_roundtrip_across_engines():
+    query = compile_query(compile_pattern(letters_pattern()), None)
+    config = EngineConfig(lanes=8, nodes=128, matches=16, nodes_per_step=4)
+    keys = [f"k{i}" for i in range(4)]
+    bx = BatchedDeviceNFA(query, keys=keys, config=config, engine="xla")
+    rng = random.Random(3)
+    streams = {k: letters_stream(rng, 12) for k in keys}
+    bx.advance({k: s[:6] for k, s in streams.items()})
+    snap = bx.snapshot()
+    # Restore into the pallas engine: K_padded grows 4 -> 8 with padding.
+    bp = BatchedDeviceNFA.restore(
+        query, snap, config=config, engine="pallas_interpret"
+    )
+    assert bp.K_padded == 8
+    ox = bx.advance({k: s[6:] for k, s in streams.items()})
+    op = bp.advance({k: s[6:] for k, s in streams.items()})
+    assert set(ox) == set(op)
+    for k in ox:
+        assert [sequence_to_json(s) for s in ox[k]] == [
+            sequence_to_json(s) for s in op[k]
+        ]
