@@ -389,6 +389,51 @@ def bench_device_batched(
     )
 
 
+def bench_device_latency(
+    pattern_fn: Callable, schema_fn, stream_fn: Callable,
+    config: EngineConfig, n_keys: int, batch: int, n_batches: int,
+) -> Dict[str, Any]:
+    """Latency-frontier run: small batches, decode + block on every one.
+
+    Every batch is a drain, so BatchTimings' emit latency (advance dispatch
+    -> drain return) is the p99 an outside observer sees per micro-batch.
+    """
+    schema = schema_fn() if schema_fn else None
+    query = compile_query(compile_pattern(pattern_fn()), schema)
+    bat = BatchedDeviceNFA(
+        query, keys=[f"k{i}" for i in range(n_keys)], config=config,
+        engine=ARGS.engine,
+    )
+    rng = random.Random(23)
+    streams = {k: stream_fn(rng, batch * (n_batches + 1)) for k in bat.keys}
+    packed = [
+        bat.pack({k: s[b * batch: (b + 1) * batch] for k, s in streams.items()})
+        for b in range(n_batches + 1)
+    ]
+    from kafkastreams_cep_tpu.ops.profiling import BatchTimings
+
+    bat.advance_packed(packed[0], decode=True)  # warmup
+    jax.block_until_ready(bat.state["n_events"])
+    bat.timings = BatchTimings()
+    t0 = time.perf_counter()
+    n_matches = 0
+    for xs in packed[1:]:
+        out = bat.advance_packed(xs, decode=True)
+        n_matches += sum(len(v) for v in out.values())
+    dt = time.perf_counter() - t0
+    summary = bat.timings.summary()
+    stats = bat.stats
+    n = n_batches * batch * n_keys
+    return dict(
+        events=n, seconds=dt, eps=n / dt, matches=n_matches,
+        keys=n_keys, batch=batch, engine=bat.engine,
+        p50_match_emit_ms=summary.get("emit_latency_ms_p50"),
+        p99_match_emit_ms=summary.get("emit_latency_ms_p99"),
+        lane_drops=stats["lane_drops"], node_drops=stats["node_drops"],
+        match_drops=stats["match_drops"],
+    )
+
+
 def bench_multi_query(
     n_queries: int, n_keys: int, batch: int, n_batches: int
 ) -> Dict[str, Any]:
@@ -494,26 +539,52 @@ def main() -> None:
         log(f"skip_any8_batched: K={n_keys} T={bb}")
         batched = bench_device_batched(
             skip_any8_pattern, None, skip_any8_stream,
-            EngineConfig(lanes=128, nodes=1024, matches=128, matches_per_step=16,
-                         nodes_per_step=64, strict_windows=True),
+            # Sized for ZERO drop counters at K=2048 (lane/node/match):
+            # zero silent loss is part of the contract, not a footnote
+            # (PERF.md "Capacity policy").
+            EngineConfig(lanes=256, nodes=1024, matches=8192,
+                         matches_per_step=32, nodes_per_step=32,
+                         strict_windows=True),
             n_keys, bb, nb,
         )
         detail["skip_any8_batched"] = batched
         log(f"skip_any8_batched: {batched['eps']:.0f} ev/s; highcard letters")
         hc = bench_device_batched(
             letters_pattern, None, letters_stream,
-            EngineConfig(lanes=8, nodes=1024, matches=64),
+            EngineConfig(lanes=8, nodes=1024, matches=2048,
+                         matches_per_step=4, nodes_per_step=8),
             (ARGS.keys or (8 if quick else 4096)), bb, nb,
         )
         detail["highcard_letters_batched"] = hc
         # Config 2 deployed shape: the stock query batched over keys.
         log("stock_rising_batched")
+        # Sized for ZERO drops: stock_rising completes >1 match per event
+        # (one_or_more expansion), so the per-step caps must cover a full
+        # lane population and the ring one whole page -- auto-drain then
+        # drains every batch. Slower than a lossy config and honest
+        # (r03 silently discarded half its matches; see PERF.md).
         detail["stock_rising_batched"] = bench_device_batched(
             stock_pattern, stock_schema, stock_stream,
-            EngineConfig(lanes=128, nodes=4096, matches=256,
-                         matches_per_step=64, nodes_per_step=256),
+            EngineConfig(lanes=384, nodes=4096, matches=24576,
+                         matches_per_step=384, nodes_per_step=384),
             (ARGS.keys or (8 if quick else 512)), bb, nb,
         )
+        # Latency frontier: small per-drain batches (BASELINE.md names p99
+        # match-emit latency a co-equal metric). T=8 with a decode+block
+        # every batch trades throughput for a ~two-orders-lower p99 than
+        # the throughput config's deferred drains.
+        log("skip_any8_latency (T=8, per-batch drain)")
+        lat_keys = ARGS.keys or (8 if quick else 2048)
+        lat_T = 4 if quick else 8
+        lat_nb = 4 if quick else 24
+        lat = bench_device_latency(
+            skip_any8_pattern, None, skip_any8_stream,
+            EngineConfig(lanes=256, nodes=1024, matches=1024,
+                         matches_per_step=32, nodes_per_step=32,
+                         strict_windows=True),
+            lat_keys, lat_T, lat_nb,
+        )
+        detail["skip_any8_latency"] = lat
         # Config 4: N concurrent queries over one stream.
         log("multi_query (config 4)")
         detail["multi_query"] = bench_multi_query(

@@ -66,7 +66,14 @@ long token_of(PyObject* vocab, PyObject* rev, PyObject* value) {
   PyObject* next_obj = PyLong_FromSsize_t(next);
   if (next_obj == nullptr || PyDict_SetItem(vocab, value, next_obj) < 0) {
     Py_XDECREF(next_obj);
+    // Roll the rev append back with the original error parked: DelItem
+    // must not run with an exception pending, and a rollback failure must
+    // not clear the original error (callers treat -1 + no-exception as a
+    // legitimate token).
+    PyObject *etype, *evalue, *etrace;
+    PyErr_Fetch(&etype, &evalue, &etrace);
     if (PySequence_DelItem(rev, next) < 0) PyErr_Clear();
+    PyErr_Restore(etype, evalue, etrace);
     return -1;
   }
   Py_DECREF(next_obj);

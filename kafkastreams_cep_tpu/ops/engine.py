@@ -29,15 +29,20 @@ the *same transition relation* as a data-parallel program:
     match extraction is a host-side (or batched-gather) predecessor walk.
     Refcount GC is replaced by mark-sweep compaction at batch boundaries.
 
-Known, documented divergences from the oracle (both unobservable in the
-conformance suite; counted by the `seq_collisions` stat so a workload that
-hits them is detectable):
+Known, documented divergences from the oracle:
 
   * fold registers are stored per lane with copy-on-emit; two live lanes
     sharing one run id (possible after PROCEED+TAKE branching) receive their
     own lane's updates rather than a shared per-run cell, and predicates read
     the event-start snapshot rather than seeing earlier queue items' folds
-    within the same event;
+    within the same event. This divergence is OBSERVABLE (constructed
+    branchy-fold seeds produce different match sets -- replicating the
+    reference's queue-sequential write-through would serialize fold
+    evaluation across lanes); the `seq_collisions` counter is a *sound
+    detector*: every event that could diverge bumps it, and
+    seq_collisions == 0 guarantees oracle-exact output
+    (tests/test_differential.py::test_seq_collision_detector_soundness,
+    ::test_seq_collision_divergence_is_real);
   * buffer-node refcounts are not maintained on device (GC is mark-sweep),
     so the reference's refcount quirks (MatchedEvent.java:66-68) have no
     analog here.

@@ -46,6 +46,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from ..pattern.expressions import Env
 from .engine import EngineConfig
@@ -60,6 +61,10 @@ from .tables import (
 )
 
 _I32_MAX = np.int64(2**31 - 1)
+# HIGHEST (f32-emulating bf16 passes) is required for exact integer
+# transport through the selection matmuls: DEFAULT rounds the 16-bit
+# planes (bf16 has an 8-bit significand) -- measured on hardware as
+# corrupted run ids (seq_collisions) at production shapes.
 HI = jax.lax.Precision.HIGHEST
 
 #: per-lane i32 state fields, in the stacked-lanes array order.
@@ -80,8 +85,8 @@ def supports_pallas(query: CompiledQuery, config: EngineConfig) -> Optional[str]
     p_cap = config.nodes_per_step if config.nodes_per_step > 0 else R * L
     if p_cap > 512:
         return f"nodes_per_step window {p_cap} > 512 (VMEM budget)"
-    if config.matches_per_step > 256:
-        return f"matches_per_step {config.matches_per_step} > 256"
+    if config.matches_per_step > 512:
+        return f"matches_per_step {config.matches_per_step} > 512"
     # Node ids must survive a single f32 one-hot lane (< 2^24); the window
     # base grows with the batch length, checked per-advance in the builder.
     if config.nodes >= (1 << 24):
@@ -213,22 +218,42 @@ def build_pallas_batched_advance(
     CI = XI_BASE + len(int_fields) + P
     CF = len(f32_fields)
 
-    def lut_i(ids: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
-        """Unrolled per-lane table lookup (ids -1 -> 0)."""
-        acc = jnp.zeros_like(ids)
-        for i in range(N_ST):
-            v = int(table[i])
-            if v != 0:
-                acc = jnp.where(ids == i, jnp.int32(v), acc)
-        return acc
+    # Per-lane stage lookups are unrolled selects over the static stage
+    # count. The `ids == i` compare masks are memoized per distinct stage-id
+    # array (trace-level, keyed by object identity): the step performs
+    # ~10 lookups against each of a handful of id arrays, and the kernel is
+    # VPU-bound, so sharing the N_ST compares across lookups is a measured
+    # win over recomparing inside every lut.
+    def make_luts():
+        cache: Dict[int, Any] = {}
 
-    def lut_b(ids: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
-        """Unrolled boolean lookup (ids -1 -> False)."""
-        acc = jnp.zeros(ids.shape, bool)
-        for i in range(N_ST):
-            if bool(table[i]):
-                acc = acc | (ids == i)
-        return acc
+        def masks_for(ids: jnp.ndarray) -> List[jnp.ndarray]:
+            got = cache.get(id(ids))
+            if got is None:
+                got = (ids, [ids == i for i in range(N_ST)])
+                cache[id(ids)] = got
+            return got[1]
+
+        def lut_i(ids: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
+            """Unrolled per-lane table lookup (ids -1 -> 0)."""
+            eq = masks_for(ids)
+            acc = jnp.zeros_like(ids)
+            for i in range(N_ST):
+                v = int(table[i])
+                if v != 0:
+                    acc = jnp.where(eq[i], jnp.int32(v), acc)
+            return acc
+
+        def lut_b(ids: jnp.ndarray, table: np.ndarray) -> jnp.ndarray:
+            """Unrolled boolean lookup (ids -1 -> False)."""
+            eq = masks_for(ids)
+            acc = jnp.zeros(ids.shape, bool)
+            for i in range(N_ST):
+                if bool(table[i]):
+                    acc = acc | eq[i]
+            return acc
+
+        return masks_for, lut_i, lut_b
 
     # Triangular matrix for lane-axis exclusive cumsums (tri[r', r] = 1 iff
     # r' < r, so  counts @ tri  is the exclusive scan). Built with iota
@@ -252,26 +277,35 @@ def build_pallas_batched_advance(
     ) -> jnp.ndarray:
         """DFS-order one-hot compaction: output [8, F, n_out] f32 where
         out[k, :, j] = the slot fields at the j-th set mask bit in
-        (lane-major, slot-minor) rank order. Unselected j stay 0."""
-        jiota = jax.lax.broadcasted_iota(jnp.int32, (1, 1, n_out), 2)
-        sel = None
+        (lane-major, slot-minor) rank order. Unselected j stay 0.
+
+        The output axis is processed in 128-wide chunks, slot-outermost so
+        each slot's one-hot transients die before the next slot's are
+        built -- without chunking, large (lanes, slots, caps) configs blow
+        the 16 MB VMEM scoped-allocation limit (seen at lanes>=192 with
+        9 slots)."""
+        offsets = list(range(0, n_out, 128))
+        acc: List[Optional[jnp.ndarray]] = [None] * len(offsets)
         for mask, rank, fields in zip(masks, ranks, fields_per_slot):
-            oh = (
-                (rank[:, :, None] == jiota)
-                & (mask.astype(jnp.int32)[:, :, None] != 0)
-            ).astype(jnp.float32)  # (8, R, n_out)
             ft = jnp.stack(fields, axis=1)  # (8, F, R)
-            part = jax.lax.dot_general(
-                ft, oh, (((2,), (1,)), ((0,), (0,))), precision=HI
-            )
-            sel = part if sel is None else sel + part
-        return sel
+            mi = mask.astype(jnp.int32)[:, :, None] != 0
+            rk = rank[:, :, None]
+            for c, j0 in enumerate(offsets):
+                w = min(128, n_out - j0)
+                jiota = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w), 2) + j0
+                oh = ((rk == jiota) & mi).astype(jnp.float32)  # (8, R, w)
+                p = jax.lax.dot_general(
+                    ft, oh, (((2,), (1,)), ((0,), (0,))), precision=HI
+                )
+                acc[c] = p if acc[c] is None else acc[c] + p
+        return acc[0] if len(acc) == 1 else jnp.concatenate(acc, axis=2)
 
     def kernel(
         xi_ref, xf_ref, lanes_ref, ver_ref, regs_ref, rset_ref, ctr_ref,
         lanes_o, ver_o, regs_o, rset_o, ctr_o, wev_o, wnm_o, wpr_o, wmt_o,
     ):
         t = pl.program_id(1)
+        masks_for, lut_i, lut_b = make_luts()
 
         @pl.when(t == 0)
         def _():
@@ -323,11 +357,12 @@ def build_pallas_batched_advance(
                 pred_vals.append(jnp.broadcast_to(sp != 0, (8, R)))
 
         def lut_pred(ids: jnp.ndarray, pid_table: np.ndarray) -> jnp.ndarray:
+            eq = masks_for(ids)
             acc = jnp.zeros(ids.shape, bool)
             for i in range(N_ST):
                 pid = int(pid_table[i])
                 if pid >= 0:
-                    acc = acc | ((ids == i) & pred_vals[pid])
+                    acc = acc | (eq[i] & pred_vals[pid])
             return acc
 
         # -- window expiry (engine.py:330-352) -------------------------------
@@ -841,6 +876,12 @@ def build_pallas_batched_advance(
                 jax.ShapeDtypeStruct((T, K, P_CAP), jnp.int32),
                 jax.ShapeDtypeStruct((T, K, M_STEP), jnp.int32),
             ],
+            compiler_params=pltpu.CompilerParams(
+                # Large (lanes, slots, caps) configs need more than the
+                # 16 MB default scoped-VMEM budget for the selection
+                # transients; v5e has headroom above the default.
+                vmem_limit_bytes=100 * 1024 * 1024,
+            ),
             interpret=interpret,
         )(xi, xf, lanes, ver, regs, rset, ctr)
         lanes_o, ver_o, regs_o, rset_o, ctr_o, wev, wnm, wpr, wmt = outs

@@ -30,7 +30,8 @@ from .aggregates import AggregatesStore
 from .buffer import BufferNode, BufferStore, SharedVersionedBuffer
 from .nfa_store import NFAStates, NFAStore
 
-MAGIC = b"KCT3"  # format tag + version (3: batched leaves store the key axis last)
+MAGIC = b"KCT4"  # format tag + version (4: paged pend ring -- pool carries
+                 # pend_pos + pinned leaves; 3: batched leaves key-axis-last)
 
 
 def _default_serialize(obj: Any) -> bytes:

@@ -287,3 +287,326 @@ def test_differential_multikey(seed):
         assert got[k] == expected[k], f"key {k} diverged"
         assert bat.runs(k) == oracles[k].runs
         assert bat.n_live(k) == len(oracles[k].computation_stages)
+
+
+# ---------------------------------------------------------------------------
+# Deep harness (VERDICT r3 item 9): 5-6-stage patterns over long streams
+# (>=100 events), full strategy space, strict windows, random batch splits.
+# ---------------------------------------------------------------------------
+def random_pattern_deep(rng: random.Random, n_stages: int):
+    qb = QueryBuilder()
+    builder = None
+    for i in range(n_stages):
+        last = i == n_stages - 1
+        strategy = (
+            None
+            if i == 0
+            else rng.choice(
+                [None, Selected.with_skip_til_next_match(), Selected.with_skip_til_any_match()]
+            )
+        )
+        name = f"s{i}"
+        sel = qb.select(name) if strategy is None else qb.select(name, strategy)
+        if builder is not None:
+            sel = (
+                builder.then().select(name)
+                if strategy is None
+                else builder.then().select(name, strategy)
+            )
+        if not last and i > 0:
+            card = rng.randint(0, 4)
+            if card == 1:
+                sel = sel.one_or_more()
+            elif card == 2:
+                sel = sel.zero_or_more()
+            elif card == 3:
+                sel = sel.times(2)
+            elif card == 4:
+                sel = sel.optional()
+        letter = rng.choice(ALPHABET[: 2 + min(i, 2)])
+        pred = value() == letter
+        if i > 0 and rng.random() < 0.4:
+            pred = pred & (agg("cnt0", default=0) <= rng.randint(1, 4))
+        builder = sel.where(pred)
+        if i == 0 or rng.random() < 0.4:
+            builder = builder.fold(
+                f"cnt{i}" if i else "cnt0",
+                agg("cnt0" if not i else f"cnt{i}", default=0) + 1,
+            )
+    return builder.within(ms=rng.choice([6, 12, 20])).build()
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_differential_deep(seed):
+    rng = random.Random(900_000 + seed)
+    pattern = random_pattern_deep(rng, rng.randint(5, 6))
+    events = random_stream(rng, 100 + rng.randint(0, 28))
+
+    stages = compile_pattern(pattern)
+    oracle = NFA.build(
+        stages, AggregatesStore(), SharedVersionedBuffer(), strict_windows=True
+    )
+    expected = []
+    for e in events:
+        expected.extend(oracle.match_pattern(e))
+
+    dev = DeviceNFA(
+        compile_pattern(pattern),
+        config=EngineConfig(lanes=1024, nodes=8192, matches=2048,
+                            matches_per_step=1024, strict_windows=True),
+    )
+    got = []
+    i = 0
+    while i < len(events):
+        step = rng.randint(1, 17)
+        got.extend(dev.advance(events[i : i + step]))
+        i += step
+
+    assert dev.stats["lane_drops"] == 0 and dev.stats["node_drops"] == 0
+    assert dev.stats["match_drops"] == 0
+    assert got == expected
+    assert dev.runs == oracle.runs
+    assert dev.n_live == len(oracle.computation_stages)
+
+
+# ---------------------------------------------------------------------------
+# Multi-topic multikey harness (VERDICT r3 item 9): two source topics,
+# topic-gated predicates, strict windows, ragged [T, K] batches.
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("seed", range(15))
+def test_differential_multitopic(seed):
+    from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA
+    from kafkastreams_cep_tpu.pattern.expressions import topic_is
+
+    rng = random.Random(656_000 + seed)
+    n_stages = rng.randint(3, 4)
+    qb = QueryBuilder()
+    builder = None
+    for i in range(n_stages):
+        strategy = (
+            None
+            if i == 0
+            else rng.choice([None, Selected.with_skip_til_next_match()])
+        )
+        name = f"s{i}"
+        sel = qb.select(name) if strategy is None else qb.select(name, strategy)
+        if builder is not None:
+            sel = (
+                builder.then().select(name)
+                if strategy is None
+                else builder.then().select(name, strategy)
+            )
+        pred = value() == rng.choice(ALPHABET[: 2 + i])
+        if rng.random() < 0.5:
+            # Topic-gated stage: only one of the two source topics advances it.
+            pred = pred & topic_is(rng.choice(["t1", "t2"]))
+        builder = sel.where(pred)
+    pattern = builder.within(ms=16).build()
+    stages = compile_pattern(pattern)
+
+    keys = [f"key{i}" for i in range(rng.randint(2, 3))]
+    streams = {}
+    for key in keys:
+        events = []
+        ts = 1000
+        for i in range(rng.randint(30, 60)):
+            ts += rng.choice([0, 1, 2, 5])
+            events.append(
+                Event(key, rng.choice(ALPHABET), ts, rng.choice(["t1", "t2"]), 0, i)
+            )
+        streams[key] = events
+
+    expected = {}
+    for key in keys:
+        oracle = NFA.build(
+            stages, AggregatesStore(), SharedVersionedBuffer(),
+            strict_windows=True,
+        )
+        acc = []
+        for e in streams[key]:
+            acc.extend(oracle.match_pattern(e))
+        expected[key] = acc
+
+    bat = BatchedDeviceNFA(
+        compile_pattern(pattern),
+        keys=keys,
+        config=EngineConfig(lanes=256, nodes=4096, matches=512,
+                            matches_per_step=256, strict_windows=True),
+    )
+    got = {k: [] for k in keys}
+    cursors = {k: 0 for k in keys}
+    while any(cursors[k] < len(streams[k]) for k in keys):
+        batch = {}
+        for k in keys:
+            step = rng.randint(0, 9)
+            if step == 0 or cursors[k] >= len(streams[k]):
+                continue
+            batch[k] = streams[k][cursors[k] : cursors[k] + step]
+            cursors[k] += len(batch[k])
+        if not batch:
+            continue
+        for k, seqs in bat.advance(batch).items():
+            got[k].extend(seqs)
+
+    assert bat.stats["match_drops"] == 0
+    for k in keys:
+        assert got[k] == expected[k], f"key {k} diverged"
+
+
+# ---------------------------------------------------------------------------
+# Capacity-pressure differentials (VERDICT r3 item 4): the drop paths are
+# part of the contract. Lane overflow evicts deterministically (the engine
+# keeps the FIRST `lanes` surviving slots in DFS emission order -- newest
+# emissions drop first) and must only ever LOSE matches, never invent them;
+# match-path overflow must account exactly.
+# ---------------------------------------------------------------------------
+def _subsequence(sub, full):
+    it = iter(full)
+    return all(any(x == y for y in it) for x in sub)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_differential_lane_pressure(seed):
+    from kafkastreams_cep_tpu.streams.serde import sequence_to_json
+
+    rng = random.Random(313_000 + seed)
+    pattern = random_pattern_extended(rng)
+    events = random_stream(rng, 64)
+
+    stages = compile_pattern(pattern)
+    oracle = NFA.build(
+        stages, AggregatesStore(), SharedVersionedBuffer(), strict_windows=True
+    )
+    expected = []
+    for e in events:
+        expected.extend(oracle.match_pattern(e))
+
+    # Tiny lane pool: overflow is expected; emitted matches must be a
+    # subsequence of the oracle's (no fabricated or reordered matches).
+    dev = DeviceNFA(
+        compile_pattern(pattern),
+        config=EngineConfig(lanes=4, nodes=512, matches=256,
+                            matches_per_step=256, strict_windows=True),
+    )
+    got = dev.advance(list(events))
+    exp_json = [sequence_to_json(s) for s in expected]
+    got_json = [sequence_to_json(s) for s in got]
+    assert _subsequence(got_json, exp_json), "engine invented/reordered matches"
+    if dev.stats["lane_drops"] == 0:
+        # No pressure this seed: output must then be exact.
+        assert got_json == exp_json
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_differential_match_cap_pressure(seed):
+    """With generous lanes but matches_per_step=1, every dropped match is
+    counted: emitted + match_drops == oracle total, and emitted is an
+    order-preserving subset."""
+    from kafkastreams_cep_tpu.streams.serde import sequence_to_json
+
+    rng = random.Random(272_000 + seed)
+    pattern = random_pattern_extended(rng)
+    events = random_stream(rng, 64)
+
+    stages = compile_pattern(pattern)
+    oracle = NFA.build(
+        stages, AggregatesStore(), SharedVersionedBuffer(), strict_windows=True
+    )
+    expected = []
+    for e in events:
+        expected.extend(oracle.match_pattern(e))
+
+    dev = DeviceNFA(
+        compile_pattern(pattern),
+        config=EngineConfig(lanes=512, nodes=4096, matches=1024,
+                            matches_per_step=1, strict_windows=True),
+    )
+    got = dev.advance(list(events))
+    assert dev.stats["lane_drops"] == 0 and dev.stats["node_drops"] == 0
+    assert len(got) + dev.stats["match_drops"] == len(expected)
+    exp_json = [sequence_to_json(s) for s in expected]
+    got_json = [sequence_to_json(s) for s in got]
+    assert _subsequence(got_json, exp_json)
+
+
+# ---------------------------------------------------------------------------
+# Fold-register divergence bound (VERDICT r3 item 6). The engine stores fold
+# registers per LANE with copy-on-emit; the oracle (like the reference,
+# AggregatesStoreImpl.java:55-75) shares one cell per RUN with
+# queue-sequential write-through. When two live lanes share a run id and
+# both consume in one event (PROCEED+TAKE branching), the two models can
+# produce DIFFERENT observable matches -- replicating the reference's
+# semantics exactly would serialize fold evaluation across lanes (a scan
+# over the lane axis), so the engine instead guarantees DETECTION: the
+# seq_collisions counter fires on every event that could diverge.
+# ---------------------------------------------------------------------------
+def _branchy_pattern(rng):
+    n_stages = rng.randint(3, 4)
+    qb = QueryBuilder()
+    builder = None
+    for i in range(n_stages):
+        last = i == n_stages - 1
+        strategy = (
+            None if i == 0
+            else rng.choice([None, Selected.with_skip_til_next_match(),
+                             Selected.with_skip_til_any_match()])
+        )
+        name = f"s{i}"
+        sel = qb.select(name) if strategy is None else qb.select(name, strategy)
+        if builder is not None:
+            sel = (builder.then().select(name) if strategy is None
+                   else builder.then().select(name, strategy))
+        if not last and i > 0:
+            sel = sel.zero_or_more() if rng.random() < 0.5 else sel.one_or_more()
+        letter = rng.choice(ALPHABET[: 2 + i])
+        pred = value() == letter
+        if i >= 2:
+            pred = pred & (agg("cnt", default=0) <= rng.randint(1, 3))
+        builder = sel.where(pred)
+        if i >= 1:
+            builder = builder.fold("cnt", agg("cnt", default=0) + 1)
+    return builder.build()
+
+
+def _run_branchy(seed):
+    rng = random.Random(50_000 + seed)
+    pattern = _branchy_pattern(rng)
+    events = []
+    ts = 1000
+    for i in range(20):
+        ts += rng.choice([0, 1, 1, 2])
+        events.append(Event("K", rng.choice(ALPHABET), ts, "t", 0, i))
+    stages = compile_pattern(pattern)
+    oracle = NFA.build(stages, AggregatesStore(), SharedVersionedBuffer())
+    expected = []
+    for e in events:
+        expected.extend(oracle.match_pattern(e))
+    dev = DeviceNFA(
+        compile_pattern(pattern),
+        config=EngineConfig(lanes=1024, nodes=8192, matches=4096,
+                            matches_per_step=1024),
+    )
+    got = dev.advance(list(events))
+    return got, expected, dev.stats["seq_collisions"]
+
+
+@pytest.mark.parametrize("seed", range(0, 30))
+def test_seq_collision_detector_soundness(seed):
+    """The contract: seq_collisions == 0 implies oracle-exact output. (The
+    counter may also fire on events whose divergence happens to be
+    unobservable -- it is a sound over-approximation, never a miss.)"""
+    got, expected, collisions = _run_branchy(seed)
+    if collisions == 0:
+        assert got == expected
+    # collisions > 0: divergence is permitted and flagged.
+
+
+def test_seq_collision_divergence_is_real():
+    """Hunted seed (72 of the 120-seed sweep): the per-lane register model
+    observably diverges from the oracle under run-id collisions -- this
+    test documents that the gap is REAL, not theoretical. If shared
+    per-run cells are ever implemented, this flips and the engine
+    divergence note must be updated."""
+    got, expected, collisions = _run_branchy(72)
+    assert collisions > 0
+    assert got != expected  # currently diverges; see ops/engine.py note
