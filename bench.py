@@ -565,7 +565,7 @@ def main() -> None:
         # (r03 silently discarded half its matches; see PERF.md).
         detail["stock_rising_batched"] = bench_device_batched(
             stock_pattern, stock_schema, stock_stream,
-            EngineConfig(lanes=384, nodes=4096, matches=24576,
+            EngineConfig(lanes=512, nodes=4096, matches=24576,
                          matches_per_step=384, nodes_per_step=384),
             (ARGS.keys or (8 if quick else 512)), bb, nb,
         )

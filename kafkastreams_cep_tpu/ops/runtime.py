@@ -25,7 +25,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..core.event import Event
-from ..core.sequence import Sequence, SequenceBuilder
+from ..core.sequence import Sequence, SequenceBuilder, Staged
 from ..pattern.stages import Stages
 import jax
 
@@ -314,8 +314,52 @@ def materialize_sequence(
     name_of_id: List[str],
     events: Dict[int, Event],
 ) -> Sequence:
-    """Build a host `Sequence` from an oldest-first (name-id, gidx) chain."""
-    builder: SequenceBuilder = SequenceBuilder()
+    """Build a host `Sequence` from an oldest-first (name-id, gidx) chain.
+
+    Equivalent to SequenceBuilder().add(...) per node, but grouped first so
+    each stage sorts once instead of per-add -- decode materializes every
+    match of a drain, so this is the drain's hottest Python loop."""
+    # Group by the stage NAME string, not name_id: ids are keyed by
+    # (name, type), so e.g. a begin-position one_or_more compiles to a
+    # BEGIN-typed and a NORMAL-typed stage sharing one name whose nodes
+    # must land in one group (as SequenceBuilder merges them).
+    groups: Dict[str, List[Event]] = {}
+    order: List[str] = []
     for name_id, gidx in chain:
-        builder.add(name_of_id[name_id], events[gidx])
-    return builder.build()
+        name = name_of_id[name_id]
+        lst = groups.get(name)
+        if lst is None:
+            lst = groups[name] = []
+            order.append(name)
+        lst.append(events[gidx])
+    matched: List[Staged] = []
+    for name in order:
+        evs = groups[name]
+        # Staged's sorted(set(...)) normalization costs Python-level
+        # __hash__/__lt__ per element -- the decode hot spot. It can be
+        # skipped exactly when the group is provably already normalized
+        # under the Event contract (identity AND order are offset-based
+        # within one (topic, partition)): all events share one
+        # (topic, partition) and offsets strictly increase.
+        first = evs[0]
+        topic = first.topic
+        partition = first.partition
+        prev = None
+        normalized = True
+        for e in evs:
+            if (
+                e.topic != topic
+                or e.partition != partition
+                or (prev is not None and e.offset <= prev)
+            ):
+                normalized = False
+                break
+            prev = e.offset
+        if normalized:
+            st = Staged.__new__(Staged)
+            st.stage = name
+            st._events = evs
+            matched.append(st)
+        else:
+            matched.append(Staged(name, evs))
+    return Sequence(matched)

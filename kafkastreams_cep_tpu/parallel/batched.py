@@ -617,16 +617,16 @@ class BatchedDeviceNFA:
         flat_event = node_event.reshape(-1)
         flat_name = node_name.reshape(-1)
 
-        starts: List[int] = []
-        match_key: List[int] = []
-        counts = raw["counts"]
-        for k in range(K):
-            row = pend[k, : int(counts[k])]
-            for nid in row:
-                # GC-nulled entries (region overflow remapped the id to -1;
-                # node_drops counts them) survive as -1 after compaction.
-                starts.append(int(nid) + k * B if nid >= 0 else -1)
-                match_key.append(k)
+        # Vectorized starts: row-major nonzero keeps per-key emission order.
+        # GC-nulled entries (region overflow remapped the id to -1;
+        # node_drops counts them) survive as -1 after compaction and decode
+        # to dead chains.
+        counts = np.asarray(raw["counts"], np.int64)
+        jmask = np.arange(pend.shape[1])[None, :] < counts[:, None]
+        ks, js = np.nonzero(jmask)
+        vals = pend[ks, js].astype(np.int64)
+        starts = np.where(vals >= 0, vals + ks * B, -1)
+        match_key = ks
         chains = decode_chains(
             np.asarray(starts, np.int64), flat_name, flat_event, flat_pred
         )

@@ -47,6 +47,12 @@ class QueryNode(Generic[K, V]):
     runtime="tpu": the micro-batching batched device driver
     (streams/device_processor.py); matches surface when a batch fills or on
     `Topology.flush()`.
+
+    Pick by key cardinality: the device engine parallelizes over record
+    keys, so "tpu" wins on many-key/high-volume topics while "host" wins
+    below roughly 64 concurrently active keys (per-batch kernel latency is
+    unamortized there -- PERF.md). The two runtimes share stores, serdes
+    and topology wiring; switching is this one argument.
     """
 
     def __init__(

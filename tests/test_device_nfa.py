@@ -360,3 +360,27 @@ def test_skip_til_any_match_on_latest_stage():
     assert live[1]["sequence"] == 2
     assert live[1]["last_event"] is None
     assert len(matches) == 2
+
+
+def test_begin_one_or_more_merges_stage_groups():
+    """A begin-position one_or_more compiles to a BEGIN-typed and a
+    NORMAL-typed stage sharing one name; match decode must merge their
+    buffer nodes into ONE Staged group exactly as the host oracle does
+    (regression: grouping by name_id split them)."""
+    pattern = (
+        QueryBuilder()
+        .select("first").one_or_more().where(value() == "C")
+        .then()
+        .select("latest").where(value() == "D")
+        .build()
+    )
+    events = [
+        Event("k", "C", TS, "t", 0, 0),
+        Event("k", "C", TS + 1, "t", 0, 1),
+        Event("k", "D", TS + 2, "t", 0, 2),
+    ]
+    oracle, dev, matches = run_both(pattern, events)
+    assert matches, "expected at least one match"
+    for seq in matches:
+        names = [st.stage for st in seq.matched]
+        assert len(names) == len(set(names)), f"duplicate groups: {names}"
