@@ -868,10 +868,11 @@ def build_gc(query: CompiledQuery, config: EngineConfig):
     The host analog of the reference's refcount GC
     (SharedVersionedBufferStoreImpl.java:176-201). vmap over the trailing
     key axis for the multi-key engine (key_shard.build_batched_post).
-    Note: `pinned` over-approximates pend-reachability with *all* marked
-    nodes, so lane chains whose runs die stay resident until the next
-    drain clears the pins -- bounded garbage traded for the O(page)
-    frontier.
+    Note: the mark runs in two phases so `pinned` is exactly the
+    pend-reachable closure (old pins + this advance's page), never the
+    lane-reachable set: pinning lane-only chains would leak them forever
+    on match-free streams, where the empty pend ring makes every drain a
+    no-op that never clears pins (the round-4 advisory leak).
     """
     B = config.nodes
     R = config.lanes
@@ -926,7 +927,10 @@ def build_gc(query: CompiledQuery, config: EngineConfig):
             marked, _ = jax.lax.while_loop(cond, body, (marked, frontier))
             return marked
 
-        marked = walk(marked0, lane_roots)
+        # Phase 1: the pend-reachable closure = old pins (already a closed
+        # set: preds of pinned nodes are pinned) + this advance's match
+        # page. This closure -- and ONLY this closure -- becomes the new
+        # `pinned` bitmap, so match-free streams keep pinned empty.
         TM_page = page_roots.shape[0]
         m_step = max(config.matches_per_step, 1)
         if TM_page % m_step == 0 and TM_page > m_step:
@@ -935,8 +939,13 @@ def build_gc(query: CompiledQuery, config: EngineConfig):
         else:
             page_sm = page_roots
         CHUNK = 256
+        marked_pin = marked0
         for c0 in range(0, TM_page, CHUNK):
-            marked = walk(marked, page_sm[c0 : c0 + CHUNK])
+            marked_pin = walk(marked_pin, page_sm[c0 : c0 + CHUNK])
+        # Phase 2: + live-lane chains (kept this GC, but NOT pinned -- if
+        # the lane survives, the next GC re-marks them from the lane root).
+        marked = walk(marked_pin, lane_roots)
+        marked_pin = marked_pin[:BW]
         marked = marked[:BW]
 
         # -- 2. compact into a fresh region [B] ------------------------------
@@ -962,7 +971,7 @@ def build_gc(query: CompiledQuery, config: EngineConfig):
             "pend": jnp.where(pend >= 0, remap_full[pend.clip(0)], -1),
             "pend_count": pool["pend_count"],
             "pend_pos": pool["pend_pos"],
-            "pinned": marked[sel] & ok,
+            "pinned": marked_pin[sel] & ok,
         }
         new_state = {
             **state,

@@ -384,3 +384,43 @@ def test_begin_one_or_more_merges_stage_groups():
     for seq in matches:
         names = [st.stage for st in seq.matched]
         assert len(names) == len(set(names)), f"duplicate groups: {names}"
+
+
+def test_gc_pins_do_not_leak_on_match_free_streams():
+    """Round-4 advisory (high): pinning every GC survivor leaked dead runs'
+    chains forever on match-free streams -- drains skip when the pend ring
+    is empty, so pins were never cleared, the region filled with garbage
+    and live chains were evicted (node_drops) before the first real match.
+
+    Pins must be exactly the pend-reachable closure: a long match-free
+    prefix of expiring runs must keep `pinned` empty, drop nothing, and the
+    first real match afterwards must still be emitted."""
+    import jax.numpy as jnp
+
+    pattern = (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .then()
+        .select("latest").where(value() == "B")
+        .within(ms=4)
+        .build()
+    )
+    dev = DeviceNFA(
+        compile_pattern(pattern), config=EngineConfig(lanes=16, nodes=64, matches=64)
+    )
+    ts = TS
+    for _ in range(120):  # match-free batches of expiring runs
+        batch = []
+        for _ in range(4):
+            batch.append(Event("k", "A", ts, "t", 0, next(_offset)))
+            ts += 8  # beyond the window: the prior run expires
+        assert dev.advance(batch) == []
+    assert int(jnp.sum(dev.pool["pinned"])) == 0, "pend-empty stream grew pins"
+    assert dev.stats["node_drops"] == 0, "pin leak evicted live chains"
+    final = [
+        Event("k", "A", ts, "t", 0, next(_offset)),
+        Event("k", "B", ts + 1, "t", 0, next(_offset)),
+    ]
+    matches = dev.advance(final)
+    assert dev.stats["node_drops"] == 0
+    assert len(matches) == 1 and [e.value for e in matches[0]] == ["A", "B"]
