@@ -19,24 +19,23 @@ import subprocess
 import sysconfig
 from typing import Any, Optional
 
-_packer: Any = None
-_tried = False
+_mods: dict = {}
 
 
 def _build_dir() -> str:
     return os.path.join(os.path.dirname(__file__), "_build")
 
 
-def _so_path() -> str:
+def _so_path(name: str) -> str:
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    return os.path.join(_build_dir(), f"_packer{suffix}")
+    return os.path.join(_build_dir(), f"_{name}{suffix}")
 
 
-def build_packer(force: bool = False) -> Optional[str]:
-    """Compile packer.cc into the package-local _build dir; returns the .so
-    path or None on failure."""
-    src = os.path.join(os.path.dirname(__file__), "packer.cc")
-    out = _so_path()
+def build_ext(name: str, force: bool = False) -> Optional[str]:
+    """Compile native/<name>.cc into the package-local _build dir; returns
+    the .so path or None on failure."""
+    src = os.path.join(os.path.dirname(__file__), f"{name}.cc")
+    out = _so_path(name)
     if not force and os.path.exists(out) and (
         os.path.getmtime(out) >= os.path.getmtime(src)
     ):
@@ -58,24 +57,48 @@ def build_packer(force: bool = False) -> Optional[str]:
     return out
 
 
+def load_ext(name: str) -> Any:
+    """The compiled native/_<name> module, or None when unavailable.
+
+    Any failure -- no compiler, no headers, sandboxed filesystem -- returns
+    None and the caller degrades to its pure-Python path (which stays the
+    semantic reference)."""
+    if name in _mods:
+        return _mods[name]
+    mod = None
+    if not os.environ.get("KCT_NO_NATIVE"):
+        so = build_ext(name)
+        if so is not None:
+            try:
+                # The module name must match the PyInit__<name> symbol.
+                spec = importlib.util.spec_from_file_location(f"_{name}", so)
+                assert spec is not None and spec.loader is not None
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+            except Exception:
+                mod = None
+    _mods[name] = mod
+    return mod
+
+
+def build_packer(force: bool = False) -> Optional[str]:
+    return build_ext("packer", force)
+
+
 def load_packer() -> Any:
-    """The compiled _packer module, or None when unavailable."""
-    global _packer, _tried
-    if _tried:
-        return _packer
-    _tried = True
-    if os.environ.get("KCT_NO_NATIVE"):
-        return None
-    so = build_packer()
-    if so is None:
-        return None
-    try:
-        # The name must match the extension's PyInit__packer symbol.
-        spec = importlib.util.spec_from_file_location("_packer", so)
-        assert spec is not None and spec.loader is not None
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        _packer = mod
-    except Exception:
-        _packer = None
-    return _packer
+    return load_ext("packer")
+
+
+def load_decoder() -> Any:
+    return load_ext("decoder")
+
+
+def cached_decoder(obj: Any) -> Any:
+    """Per-instance decoder handle: honors a test override of
+    `obj._native_dec` (set to None to force the Python reference path)."""
+    cached = getattr(obj, "_native_dec", False)
+    if cached is not False:
+        return cached
+    mod = load_decoder()
+    obj._native_dec = mod
+    return mod

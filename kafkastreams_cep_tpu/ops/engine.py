@@ -35,14 +35,16 @@ Known, documented divergences from the oracle:
     sharing one run id (possible after PROCEED+TAKE branching) receive their
     own lane's updates rather than a shared per-run cell, and predicates read
     the event-start snapshot rather than seeing earlier queue items' folds
-    within the same event. This divergence is OBSERVABLE (constructed
-    branchy-fold seeds produce different match sets -- replicating the
-    reference's queue-sequential write-through would serialize fold
-    evaluation across lanes); the `seq_collisions` counter is a *sound
-    detector*: every event that could diverge bumps it, and
-    seq_collisions == 0 guarantees oracle-exact output
+    within the same event. This divergence is ENGINE-INTERNAL and corrected
+    by replay: the `seq_collisions` counter soundly detects every event that
+    could diverge (a consuming lane sharing its run id with any other live
+    lane; seq_collisions == 0 guarantees oracle-exact engine output), and
+    the drivers' default exact-replay path (ops/replay.py) re-runs the
+    affected key's interval through the host oracle and resyncs, so the
+    *processor-visible* output is oracle-exact even when the counter fires
     (tests/test_differential.py::test_seq_collision_detector_soundness,
-    ::test_seq_collision_divergence_is_real);
+    ::test_seq_collision_divergence_recovered_by_replay; the raw engine
+    gap is pinned by ::test_seq_collision_divergence_is_real_without_replay);
   * buffer-node refcounts are not maintained on device (GC is mark-sweep),
     so the reference's refcount quirks (MatchedEvent.java:66-68) have no
     analog here.
@@ -116,9 +118,15 @@ def init_state(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndar
     R = config.lanes
     D = config.dewey_width(query)
     A = query.n_aggs
+    begins = query.begin_stages if query.begin_stages else [query.begin_stage]
+    if len(begins) > R:
+        raise ValueError(
+            f"{len(begins)} stacked queries exceed the {R}-lane pool"
+        )
 
     ver = np.zeros((R, D), np.int32)
-    ver[0, 0] = 1
+    for qi in range(len(begins)):
+        ver[qi, 0] = 1
     state = {
         # -- run lane table (SoA ComputationStage) ---------------------------
         "active": np.zeros(R, bool),
@@ -133,7 +141,7 @@ def init_state(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndar
         "ignored": np.zeros(R, bool),
         "regs": np.zeros((R, A), np.float32),  # fold registers (per lane)
         "regs_set": np.zeros((R, A), bool),
-        "runs": np.asarray(1, np.int32),       # global run counter
+        "runs": np.asarray(len(begins), np.int32),  # global run counter
         # -- observability counters (SURVEY.md section 5.1/5.5) --------------
         "n_events": np.asarray(0, np.int32),
         "n_branches": np.asarray(0, np.int32),
@@ -143,10 +151,13 @@ def init_state(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndar
         "match_drops": np.asarray(0, np.int32),
         "seq_collisions": np.asarray(0, np.int32),
     }
-    state["active"][0] = True
-    state["src"][0] = query.begin_stage
-    state["vlen"][0] = 1
-    state["seq"][0] = 1
+    # One begin lane per (stacked) query; run ids 1..Q so the fold-
+    # divergence detector never sees a cross-query collision.
+    for qi, b in enumerate(begins):
+        state["active"][qi] = True
+        state["src"][qi] = b
+        state["vlen"][qi] = 1
+        state["seq"][qi] = qi + 1
     return {k: jnp.asarray(v) for k, v in state.items()}
 
 
@@ -456,13 +467,30 @@ def build_step(
                 cur_regs, cur_set = apply_folds(levels[l], cur_regs, cur_set)
         final_regs, final_set = cur_regs, cur_set
 
-        # Same-run-id collision detector: >1 lane consuming with one run id
-        # in a single event (the documented per-lane-register divergence).
-        consuming = jnp.zeros(R, bool)
-        for l in range(L):
-            consuming = consuming | levels[l]["c_m"]
-        seq_sorted = jnp.sort(jnp.where(consuming, lane_seq, -jnp.arange(R) - 1))
-        collide = jnp.any(seq_sorted[1:] == seq_sorted[:-1])
+        # Fold-divergence detector: a consuming lane whose run id is shared
+        # with ANY other live lane diverges the per-lane register copies
+        # from the reference's shared per-run cell (AggregatesStoreImpl
+        # .java:55-75) -- whether or not the sibling consumes this event
+        # too: a one-sided fold write leaves the sibling's copy stale.
+        # (Same-run pairs CREATED this event are exact: all non-clone
+        # emissions of one source lane carry the same post-fold registers,
+        # which is the oracle's cell value.) The counter keys the
+        # exact-replay path (ops/replay.py); without folds the registers
+        # never change, divergence is impossible, and it stays 0.
+        if flat_folds:
+            consuming = jnp.zeros(R, bool)
+            for l in range(L):
+                consuming = consuming | levels[l]["c_m"]
+            idx = jnp.arange(R)
+            pair = (
+                (lane_seq[:, None] == lane_seq[None, :])
+                & consuming[:, None]
+                & active[None, :]
+                & (idx[:, None] != idx[None, :])
+            )
+            collide = jnp.any(pair)
+        else:
+            collide = jnp.zeros((), bool)
 
         # ==== buffer puts (one per consumed level, NFA.java:238-271) ========
         # Time-indexed window layout: step t's appends live in window slots
@@ -938,7 +966,7 @@ def build_gc(query: CompiledQuery, config: EngineConfig):
             page_sm = page_roots.reshape(-1, m_step).T.reshape(TM_page)
         else:
             page_sm = page_roots
-        CHUNK = 256
+        CHUNK = 256  # measured optimum on v5e (128/512/2048 all slower)
         marked_pin = marked0
         for c0 in range(0, TM_page, CHUNK):
             marked_pin = walk(marked_pin, page_sm[c0 : c0 + CHUNK])

@@ -152,16 +152,34 @@ def _join16(lo: jnp.ndarray, hi: jnp.ndarray, bias: int) -> jnp.ndarray:
     ) - bias
 
 
+def _key_axis_spec(leaf, axis: int):
+    """PartitionSpec sharding `axis` over the key mesh axis."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.key_shard import KEY_AXIS
+
+    dims = [None] * leaf.ndim
+    dims[axis] = KEY_AXIS
+    return P(*dims)
+
+
 def build_pallas_batched_advance(
     query: CompiledQuery,
     config: EngineConfig,
     interpret: bool = False,
+    mesh: Optional[Any] = None,
 ):
     """jit advance(state, xs) -> (state, ys) running the fused kernel.
 
     Contract-identical to key_shard.build_batched_advance except ys leaves
     are [T, K, cap] (key axis second) -- pair with
-    build_pallas_batched_post. K must be a multiple of 8.
+    build_pallas_batched_post. K must be a multiple of 8 (per shard).
+
+    With `mesh`, the whole advance runs under `shard_map` over the key
+    axis: every device executes the kernel on its own key slice and no
+    collective touches the per-event hot path (per-key NFAs are
+    independent; SURVEY.md section 2.8 scale-out stance) -- only the
+    drivers' stats reduction all-reduces.
     """
     R = config.lanes
     D = config.dewey_width(query)
@@ -272,8 +290,9 @@ def build_pallas_batched_advance(
     def select_slots(
         masks: List[jnp.ndarray],
         ranks: List[jnp.ndarray],
-        fields_per_slot: List[List[jnp.ndarray]],
+        fields_fns: List[Callable[[], List[jnp.ndarray]]],
         n_out: int,
+        n_fields: int,
     ) -> jnp.ndarray:
         """DFS-order one-hot compaction: output [8, F, n_out] f32 where
         out[k, :, j] = the slot fields at the j-th set mask bit in
@@ -283,21 +302,54 @@ def build_pallas_batched_advance(
         each slot's one-hot transients die before the next slot's are
         built -- without chunking, large (lanes, slots, caps) configs blow
         the 16 MB VMEM scoped-allocation limit (seen at lanes>=192 with
-        9 slots)."""
+        9 slots).
+
+        Each slot's whole contribution (field materialization, one-hot
+        build, matmul) sits behind a scalar `lax.cond` on its occupancy:
+        an empty slot's contribution is exactly zero, so skipping it is
+        bitwise-neutral -- and on a typical event step most of the 3L
+        emission slots ARE empty (clone slots occupy only on branching
+        events, re-add slots only when a begin root consumed), so the
+        runtime branch removes the kernel's dominant VPU term (the
+        [8, R, chunk] one-hot compares scale with slots x lanes x n_out)."""
+        # Escape hatch for A/B perf work: KCT_SLOT_SKIP=0 inlines every
+        # slot's contribution unconditionally (the round-4 form). Measured
+        # on v5e (skip_any8, lanes=256): cond-skipped 0.23 s/batch vs 0.90
+        # inline -- most slots are empty on most steps.
+        import os
+
+        use_cond = os.environ.get("KCT_SLOT_SKIP", "1") != "0"
         offsets = list(range(0, n_out, 128))
-        acc: List[Optional[jnp.ndarray]] = [None] * len(offsets)
-        for mask, rank, fields in zip(masks, ranks, fields_per_slot):
-            ft = jnp.stack(fields, axis=1)  # (8, F, R)
-            mi = mask.astype(jnp.int32)[:, :, None] != 0
-            rk = rank[:, :, None]
-            for c, j0 in enumerate(offsets):
-                w = min(128, n_out - j0)
-                jiota = jax.lax.broadcasted_iota(jnp.int32, (1, 1, w), 2) + j0
-                oh = ((rk == jiota) & mi).astype(jnp.float32)  # (8, R, w)
-                p = jax.lax.dot_general(
-                    ft, oh, (((2,), (1,)), ((0,), (0,))), precision=HI
-                )
-                acc[c] = p if acc[c] is None else acc[c] + p
+        acc: List[jnp.ndarray] = [
+            jnp.zeros((8, n_fields, min(128, n_out - j0)), jnp.float32)
+            for j0 in offsets
+        ]
+        for mask, rank, ffn in zip(masks, ranks, fields_fns):
+            any_occ = jnp.any(mask)
+
+            def contrib(accs, ffn=ffn, mask=mask, rank=rank):
+                ft = jnp.stack(ffn(), axis=1)  # (8, F, R)
+                mi = mask.astype(jnp.int32)[:, :, None] != 0
+                rk = rank[:, :, None]
+                out = []
+                for a, j0 in zip(accs, offsets):
+                    w = min(128, n_out - j0)
+                    jiota = (
+                        jax.lax.broadcasted_iota(jnp.int32, (1, 1, w), 2) + j0
+                    )
+                    oh = ((rk == jiota) & mi).astype(jnp.float32)  # (8, R, w)
+                    out.append(
+                        a
+                        + jax.lax.dot_general(
+                            ft, oh, (((2,), (1,)), ((0,), (0,))), precision=HI
+                        )
+                    )
+                return out
+
+            if use_cond:
+                acc = jax.lax.cond(any_occ, contrib, lambda a: list(a), acc)
+            else:
+                acc = contrib(acc)
         return acc[0] if len(acc) == 1 else jnp.concatenate(acc, axis=2)
 
     def kernel(
@@ -467,23 +519,27 @@ def build_pallas_batched_advance(
                 cur_regs, cur_set = apply_folds(levels[l], cur_regs, cur_set)
         final_regs, final_set = cur_regs, cur_set
 
-        # -- same-run-id collision detector (engine.py:447-452) -------------
-        consuming = jnp.zeros((8, R), bool)
-        for l in range(L):
-            consuming = consuming | levels[l]["c_m"]
-        seq_i = lane_seq[:, :, None]
-        pair = (
-            (seq_i == lane_seq[:, None, :])
-            & (consuming.astype(jnp.int32)[:, :, None] != 0)
-            & (consuming.astype(jnp.int32)[:, None, :] != 0)
-            & (
-                jax.lax.broadcasted_iota(jnp.int32, (1, R, R), 1)
-                < jax.lax.broadcasted_iota(jnp.int32, (1, R, R), 2)
+        # -- fold-divergence detector (engine.py: consuming lane sharing a
+        # run id with ANY other live lane; see the rationale there) --------
+        if flat_folds:
+            consuming = jnp.zeros((8, R), bool)
+            for l in range(L):
+                consuming = consuming | levels[l]["c_m"]
+            seq_i = lane_seq[:, :, None]
+            pair = (
+                (seq_i == lane_seq[:, None, :])
+                & (consuming.astype(jnp.int32)[:, :, None] != 0)
+                & (active.astype(jnp.int32)[:, None, :] != 0)
+                & (
+                    jax.lax.broadcasted_iota(jnp.int32, (1, R, R), 1)
+                    != jax.lax.broadcasted_iota(jnp.int32, (1, R, R), 2)
+                )
             )
-        )
-        collide = jnp.any(
-            jnp.any(pair, axis=2), axis=1, keepdims=True
-        )  # (8, 1)
+            collide = jnp.any(
+                jnp.any(pair, axis=2), axis=1, keepdims=True
+            )  # (8, 1)
+        else:
+            collide = jnp.zeros((8, 1), bool)
 
         # ==== buffer puts: rank + one-hot emit (engine.py:454-482) ==========
         tri = make_tri()
@@ -508,7 +564,6 @@ def build_pallas_batched_advance(
             ).astype(jnp.int32)
             for l in range(L)
         ]
-        name_planes = [lut_i(levels[l]["cs"], n_name_id) for l in range(L)]
         # w_event is gidx for every real put slot -- rank order makes it a
         # prefix, no selection needed.
         put_j = jax.lax.broadcasted_iota(jnp.int32, (8, P_CAP), 1)
@@ -520,13 +575,16 @@ def build_pallas_batched_advance(
             put_masks,
             put_ranks,
             [
-                [
-                    name_planes[l].astype(jnp.float32),
-                    (lane_node + 1).astype(jnp.float32),  # bias -1 -> 0
-                ]
+                (
+                    lambda l=l: [
+                        lut_i(levels[l]["cs"], n_name_id).astype(jnp.float32),
+                        (lane_node + 1).astype(jnp.float32),  # bias -1 -> 0
+                    ]
+                )
                 for l in range(L)
             ],
             P_CAP,
+            2,
         )
         w_name = jnp.where(put_jok & valid, psel[:, 0, :].astype(jnp.int32), -1)
         w_pred = jnp.where(
@@ -696,8 +754,9 @@ def build_pallas_batched_advance(
 
         msel = select_slots(
             match_masks, m_ranks,
-            [[(s["node"] + 1).astype(jnp.float32)] for s in slots],
+            [(lambda s=s: [(s["node"] + 1).astype(jnp.float32)]) for s in slots],
             M_STEP,
+            1,
         )
         mj = jax.lax.broadcasted_iota(jnp.int32, (8, M_STEP), 1)
         mok = mj < jnp.minimum(n_match, M_STEP)
@@ -729,7 +788,10 @@ def build_pallas_batched_advance(
 
         F_FIX = 11
         ksel = select_slots(
-            keep_masks, k_ranks, [slot_fields(s) for s in slots], R
+            keep_masks, k_ranks,
+            [(lambda s=s: slot_fields(s)) for s in slots],
+            R,
+            F_FIX + D + 2 * A,
         )
         jr = jax.lax.broadcasted_iota(jnp.int32, (8, R), 1)
         lane_ok = jr < jnp.minimum(n_keep, R)
@@ -802,8 +864,7 @@ def build_pallas_batched_advance(
         wpr_o[0] = w_pred
         wmt_o[0] = w_match
 
-    @jax.jit
-    def advance(state, xs):
+    def advance_impl(state, xs):
         T, K = xs["valid"].shape
         if K % 8 != 0:
             raise ValueError(f"pallas advance needs K % 8 == 0, got {K}")
@@ -900,11 +961,41 @@ def build_pallas_batched_advance(
         ys = {"w_event": wev, "w_name": wnm, "w_pred": wpr, "w_match": wmt}
         return new_state, ys
 
-    return advance
+    if mesh is None:
+        return jax.jit(advance_impl)
+
+    from jax.experimental.shard_map import shard_map
+
+    @jax.jit
+    def advance_sharded(state, xs):
+        state_spec = jax.tree.map(
+            lambda l: _key_axis_spec(l, l.ndim - 1), state
+        )
+        xs_spec = jax.tree.map(lambda l: _key_axis_spec(l, 1), xs)
+        ys_spec = {
+            k: _key_axis_spec(jnp.zeros((1, 1, 1)), 1)
+            for k in ("w_event", "w_name", "w_pred", "w_match")
+        }
+        return shard_map(
+            advance_impl,
+            mesh=mesh,
+            in_specs=(state_spec, xs_spec),
+            out_specs=(state_spec, ys_spec),
+            check_rep=False,
+        )(state, xs)
+
+    return advance_sharded
 
 
-def build_pallas_batched_post(query: CompiledQuery, config: EngineConfig):
-    """Post pass (pend-page append + GC) for pallas-layout ys ([T, K, cap])."""
+def build_pallas_batched_post(
+    query: CompiledQuery,
+    config: EngineConfig,
+    mesh: Optional[Any] = None,
+):
+    """Post pass (pend-page append + GC) for pallas-layout ys ([T, K, cap]).
+
+    With `mesh`, runs under `shard_map` over the key axis like the advance
+    (the append offset and GC are per-key; no collectives)."""
     from .engine import build_gc, build_pend_append
 
     append = build_pend_append(config)
@@ -912,8 +1003,7 @@ def build_pallas_batched_post(query: CompiledQuery, config: EngineConfig):
         build_gc(query, config), in_axes=(-1, -1, 1, -1), out_axes=(-1, -1)
     )
 
-    @jax.jit
-    def post(state, pool, ys):
+    def post_impl(state, pool, ys):
         # w_match arrives [T, K, M_STEP]; the append wants the key axis
         # last ([T, M_STEP, K]) so its page reshape stays t-major.
         state, pool, page_roots = append(
@@ -921,4 +1011,26 @@ def build_pallas_batched_post(query: CompiledQuery, config: EngineConfig):
         )
         return gc(state, pool, ys, page_roots)
 
-    return post
+    if mesh is None:
+        return jax.jit(post_impl)
+
+    from jax.experimental.shard_map import shard_map
+
+    @jax.jit
+    def post_sharded(state, pool, ys):
+        state_spec = jax.tree.map(
+            lambda l: _key_axis_spec(l, l.ndim - 1), state
+        )
+        pool_spec = jax.tree.map(
+            lambda l: _key_axis_spec(l, l.ndim - 1), pool
+        )
+        ys_spec = jax.tree.map(lambda l: _key_axis_spec(l, 1), ys)
+        return shard_map(
+            post_impl,
+            mesh=mesh,
+            in_specs=(state_spec, pool_spec, ys_spec),
+            out_specs=(state_spec, pool_spec),
+            check_rep=False,
+        )(state, pool, ys)
+
+    return post_sharded

@@ -50,12 +50,16 @@ class DeviceNFA:
     points) the node pool cross back to the host.
     """
 
+    #: exact-replay event-ledger bound (events per drain interval).
+    REPLAY_LEDGER_MAX_EVENTS = 1 << 20
+
     def __init__(
         self,
         stages_or_query: Any,
         schema: Optional[EventSchema] = None,
         config: Optional[EngineConfig] = None,
         events_prune_threshold: int = 1 << 16,
+        exact_replay: bool = True,
     ) -> None:
         if isinstance(stages_or_query, CompiledQuery):
             self.query = stages_or_query
@@ -75,6 +79,20 @@ class DeviceNFA:
         self._next_gidx = 0
         self._ts_base: Optional[int] = None
         self._batches = 0
+        #: Exact-replay (ops/replay.py): on a seq_collisions increment the
+        #: interval since the last drain replays through the host oracle,
+        #: restoring the reference's per-run fold semantics. Only active
+        #: for queries that can diverge (folds present).
+        from .replay import supports_replay
+
+        self.exact_replay = exact_replay and supports_replay(self.query)
+        self.replays = 0
+        # None when disarmed so no dead device generation stays referenced.
+        self._snap = (self.state, self.pool) if self.exact_replay else None
+        self._interval_events: List[Event] = []
+        self._interval_overflow = False
+        self._interval_start_gidx = 0
+        self._collision_base = 0
 
     # ------------------------------------------------------------------ API
     @property
@@ -143,6 +161,25 @@ class DeviceNFA:
         self.state, ys = self._advance(self.state, xs)
         self.state, self.pool = self._post(self.state, self.pool, ys)
         self._batches += 1
+        if self.exact_replay:
+            if (
+                len(self._interval_events) + len(events)
+                > self.REPLAY_LEDGER_MAX_EVENTS
+            ):
+                if not self._interval_overflow:
+                    import warnings
+
+                    warnings.warn(
+                        "exact-replay event ledger exceeded "
+                        f"{self.REPLAY_LEDGER_MAX_EVENTS} events without a "
+                        "drain; this interval degrades to collision "
+                        "detection only",
+                        RuntimeWarning,
+                    )
+                self._interval_overflow = True
+                self._interval_events = []
+            else:
+                self._interval_events.extend(events)
         if not decode:
             return []
         return self.drain()
@@ -150,7 +187,76 @@ class DeviceNFA:
     def drain(self) -> List[Sequence]:
         """Decode and clear all pending matches (a device sync point)."""
         matches = self._decode_matches()
+        if self.exact_replay:
+            matches = self._replay_boundary(matches)
         self._prune_events()
+        return matches
+
+    def _replay_boundary(self, matches: List[Sequence]) -> List[Sequence]:
+        """Drain-boundary replay hook: if any fold-divergence event fired
+        since the last boundary, substitute the host oracle's matches for
+        the whole interval and resync the device state from the oracle
+        (ops/replay.py). Otherwise just roll the snapshot forward."""
+        cur = int(self.state["seq_collisions"])
+        if cur > self._collision_base and self._interval_overflow:
+            import warnings
+
+            warnings.warn(
+                "fold-divergence detected but the replay ledger overflowed "
+                "this interval; matches are engine-computed for it",
+                RuntimeWarning,
+            )
+        if (
+            cur > self._collision_base
+            and self._interval_events
+            and not self._interval_overflow
+        ):
+            matches = self._replay_interval()
+        self._collision_base = int(self.state["seq_collisions"])
+        self._snap = (self.state, self.pool)
+        self._interval_events = []
+        self._interval_overflow = False
+        self._interval_start_gidx = self._next_gidx
+        return matches
+
+    def _replay_interval(self) -> List[Sequence]:
+        import warnings
+
+        from .replay import device_to_oracle, oracle_to_device
+
+        self.replays += 1
+        snap_state = {k: np.asarray(v) for k, v in self._snap[0].items()}
+        snap_pool = {k: np.asarray(v) for k, v in self._snap[1].items()}
+        key = self._interval_events[0].key
+        ts_base = self._ts_base if self._ts_base is not None else 0
+        oracle, ev_gidx = device_to_oracle(
+            self.query, self.config, snap_state, snap_pool, self._events,
+            ts_base, key,
+        )
+        matches: List[Sequence] = []
+        for i, e in enumerate(self._interval_events):
+            ev_gidx[e] = self._interval_start_gidx + i
+            matches.extend(oracle.match_pattern(e))
+        counters = {
+            k: np.asarray(self.state[k])
+            for k in (
+                "n_events", "n_branches", "n_expired",
+                "lane_drops", "node_drops", "match_drops", "seq_collisions",
+            )
+        }
+        try:
+            new_state, new_pool = oracle_to_device(
+                self.query, self.config, oracle, key, ev_gidx, ts_base,
+                counters,
+            )
+            self.state = {k: jnp.asarray(v) for k, v in new_state.items()}
+            self.pool = {k: jnp.asarray(v) for k, v in new_pool.items()}
+        except (ValueError, KeyError) as exc:
+            warnings.warn(
+                f"exact-replay resync failed ({exc}); device state kept -- "
+                "this interval's matches are oracle-exact but later "
+                "intervals fall back to collision detection only"
+            )
         return matches
 
     # ------------------------------------------------------------ internals
@@ -190,16 +296,36 @@ class DeviceNFA:
         node_name = np.asarray(self.pool["node_name"])
         node_pred = np.asarray(self.pool["node_pred"])
 
-        chains = decode_chains(pend, node_name, node_event, node_pred)
-        # Empty chains = pend entries whose nodes were GC-dropped under
-        # region overflow (node_drops counts them).
-        out = [
-            materialize_sequence(chain, self.query.name_of_id, self._events)
-            for chain in chains
-            if chain
-        ]
+        native = self._native_decoder()
+        if native is not None:
+            out = native.decode_matches(
+                np.asarray([len(pend)], np.int32),
+                pend[None, :],
+                node_event[None, :],
+                node_name[None, :],
+                node_pred[None, :],
+                self.query.name_of_id,
+                self._events,
+                Staged,
+                Sequence,
+            )[0]
+        else:
+            chains = decode_chains(pend, node_name, node_event, node_pred)
+            # Empty chains = pend entries whose nodes were GC-dropped under
+            # region overflow (node_drops counts them).
+            out = [
+                materialize_sequence(chain, self.query.name_of_id, self._events)
+                for chain in chains
+                if chain
+            ]
         self.pool = self._drain_pend(self.pool)
         return out
+
+    def _native_decoder(self):
+        """The C match decoder module, or None (cached; test-overridable)."""
+        from ..native import cached_decoder
+
+        return cached_decoder(self)
 
     # --------------------------------------------------------- checkpointing
     def snapshot(self) -> bytes:
@@ -237,24 +363,28 @@ class DeviceNFA:
         the ComputationStageSerde.java:56-66 contract)."""
         from ..state.serde import (
             _Reader,
-            MAGIC,
             decode_array_tree,
             decode_event_registry,
+            read_magic,
+            upgrade_pool_tree,
         )
 
         dev = cls(stages_or_query, schema=schema, config=config)
         r = _Reader(data)
-        if r._read(4) != MAGIC:
-            raise ValueError("bad checkpoint magic")
+        read_magic(r)
         tree = decode_array_tree(r.blob())
         dev.state = {k: jnp.asarray(v) for k, v in tree.items()}
-        pool_tree = decode_array_tree(r.blob())
+        pool_tree = upgrade_pool_tree(decode_array_tree(r.blob()))
         dev.pool = {k: jnp.asarray(v) for k, v in pool_tree.items()}
         dev._events = decode_event_registry(r.blob())
         dev._next_gidx = r.i64()
         ts_base = r.i64()
         dev._ts_base = None if ts_base < 0 else ts_base
         dev._batches = r.i64()
+        if dev.exact_replay:
+            dev._snap = (dev.state, dev.pool)
+            dev._interval_start_gidx = dev._next_gidx
+            dev._collision_base = int(dev.state["seq_collisions"])
         return dev
 
     def _prune_events(self) -> None:

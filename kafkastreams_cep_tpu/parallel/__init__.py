@@ -1,6 +1,7 @@
 """Key-axis parallelism: vmapped multi-key engine + mesh sharding."""
 
 from .batched import BatchedDeviceNFA
+from .stacked import StackedQueryEngine
 from .key_shard import (
     KEY_AXIS,
     build_batched_advance,
@@ -16,6 +17,7 @@ from .key_shard import (
 
 __all__ = [
     "BatchedDeviceNFA",
+    "StackedQueryEngine",
     "KEY_AXIS",
     "build_batched_advance",
     "build_batched_post",

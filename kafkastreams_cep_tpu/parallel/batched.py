@@ -62,6 +62,10 @@ class BatchedDeviceNFA:
     the Pallas interpreter (conformance tests on CPU).
     """
 
+    #: exact-replay event-ledger bound (batches per drain interval); see
+    #: the `_interval_packs` note in __init__.
+    REPLAY_LEDGER_MAX_BATCHES = 256
+
     def __init__(
         self,
         stages_or_query: Any,
@@ -72,6 +76,7 @@ class BatchedDeviceNFA:
         events_prune_threshold: int = 1 << 16,
         engine: str = "auto",
         auto_drain: bool = True,
+        exact_replay: bool = True,
     ) -> None:
         if isinstance(stages_or_query, CompiledQuery):
             self.query = stages_or_query
@@ -84,6 +89,11 @@ class BatchedDeviceNFA:
         if not self.keys:
             raise ValueError("BatchedDeviceNFA needs at least one key")
         self.engine, self.engine_fallback_reason = self._pick_engine(engine)
+        #: "auto" keeps a safety net: if the kernel fails to build/compile
+        #: at first use (e.g. a TPU generation with less VMEM than the
+        #: kernel's envelope assumes), fall back to the XLA step instead of
+        #: failing the stream (round-4 advisory).
+        self._engine_auto = engine == "auto"
         # Pad the key axis to a multiple of the mesh extent so the shard is
         # even (and of the pallas kernel's 8-key block); padding lanes never
         # receive valid events.
@@ -105,8 +115,11 @@ class BatchedDeviceNFA:
             self._advance = build_pallas_batched_advance(
                 self.query, self.config,
                 interpret=(self.engine == "pallas_interpret"),
+                mesh=mesh,
             )
-            self._post = build_pallas_batched_post(self.query, self.config)
+            self._post = build_pallas_batched_post(
+                self.query, self.config, mesh=mesh
+            )
         else:
             self._advance = build_batched_advance(self.query, self.config)
             self._post = build_batched_post(self.query, self.config)
@@ -123,6 +136,17 @@ class BatchedDeviceNFA:
         #: explicit drain()/decoding advance.
         self.auto_drain = auto_drain
         self._pend_accum = 0
+        #: Async ring-cursor probes: after each advance a tiny jitted
+        #: max(pend_pos) reduction is dispatched and copied host-ward
+        #: asynchronously; the guard reads the freshest COMPLETED one to
+        #: replace the worst-case occupancy bound with (observed cursor +
+        #: caps since the observation). Long match-free runs then never
+        #: force a no-op sync drain (round-4 advisory) -- the cursor only
+        #: moves on pages that actually hold a match.
+        self._pos_probes: deque = deque()
+        self._pos_obs: Optional[Tuple[int, int]] = None  # (accum_at_obs, pos)
+        self._drain_epoch = 0
+        self._pos_max_fn = None
         self._auto_buffer: Dict[Any, List[Sequence]] = {}
         self._compact_pend_fn = None
         self.events_prune_threshold = events_prune_threshold
@@ -137,6 +161,28 @@ class BatchedDeviceNFA:
         self._ts_base: Optional[int] = None
         self._batches = 0
         self._stats_fn = None
+        #: Exact-replay (ops/replay.py): per-key fold-divergence recovery.
+        #: At each drain, keys whose seq_collisions counter moved replay
+        #: their interval through the host oracle (reference-exact per-run
+        #: fold semantics) and the device state resyncs from the oracle.
+        #: Only armed for queries that can diverge (folds present).
+        from ..ops.replay import supports_replay
+
+        self.exact_replay = exact_replay and supports_replay(self.query)
+        self.replays = 0
+        self._warned_collisions = False
+        # _snap pins a full state+pool generation; keep it None when replay
+        # is disarmed so no dead device memory stays referenced.
+        self._snap = (self.state, self.pool) if self.exact_replay else None
+        #: per-advanced-batch (gidx [T, K], valid [T, K]) host copies since
+        #: the last drain -- the replay interval's event ledger. Bounded:
+        #: past REPLAY_LEDGER_MAX_BATCHES the interval degrades to
+        #: detection-only (a drain that rarely happens would otherwise
+        #: accumulate host copies without limit).
+        self._interval_packs: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._interval_overflow = False
+        self._pack_meta: deque = deque()
+        self._collision_base = np.zeros(self.K_padded, np.int64)
         from ..ops.profiling import BatchTimings
 
         #: Per-batch dispatch/drain timings + match-emit latency histogram
@@ -158,22 +204,17 @@ class BatchedDeviceNFA:
                 reason = supports_pallas(self.query, self.config)
                 if reason is not None:
                     raise ValueError(f"pallas engine unsupported: {reason}")
-                if self.mesh is not None:
-                    raise ValueError(
-                        "pallas engine does not shard over a mesh yet; "
-                        "use engine='xla' with mesh"
-                    )
             return engine, None
         if engine != "auto":
             raise ValueError(f"unknown engine {engine!r}")
-        if self.mesh is not None:
-            return "xla", "mesh-sharded run"
         platform = jax.devices()[0].platform
         if platform != "tpu":
             return "xla", f"platform {platform!r} (pallas kernel is TPU-only)"
         reason = supports_pallas(self.query, self.config)
         if reason is not None:
             return "xla", reason
+        # A mesh shard_maps the kernel over the key axis (per-shard
+        # pallas_call; no collectives on the hot path).
         return "pallas", None
 
     def _padded_extent(self, k: int) -> int:
@@ -181,7 +222,9 @@ class BatchedDeviceNFA:
         if self.mesh is not None:
             mult = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
         if self.engine.startswith("pallas"):
-            mult = max(mult, 8)  # kernel key-block granularity
+            # Every mesh shard's key slice must be a multiple of the
+            # kernel's 8-key block.
+            mult = mult * 8
         return ((k + mult - 1) // mult) * mult
 
     # ------------------------------------------------------------------ API
@@ -207,6 +250,23 @@ class BatchedDeviceNFA:
             self.pool = jax.tree.map(
                 cat, self.pool, init_batched_pool(self.query, self.config, delta)
             )
+            if self.exact_replay:
+                # Grow the replay snapshot identically: new keys' "interval
+                # start" is their fresh init state.
+                snap_s, snap_p = self._snap
+                self._snap = (
+                    jax.tree.map(
+                        cat, snap_s,
+                        init_batched_state(self.query, self.config, delta),
+                    ),
+                    jax.tree.map(
+                        cat, snap_p,
+                        init_batched_pool(self.query, self.config, delta),
+                    ),
+                )
+                self._collision_base = np.concatenate(
+                    [self._collision_base, np.zeros(delta, np.int64)]
+                )
             self.K_padded = k_pad
             if self.mesh is not None:
                 self.state = shard_state(self.state, self.mesh)
@@ -353,6 +413,10 @@ class BatchedDeviceNFA:
         if self.mesh is not None:
             xs = shard_xs(xs, self.mesh)
         self._pack_hwms.append(self._next_gidx - 1)
+        if self.exact_replay:
+            # Host copies of the batch's event ledger, consumed (FIFO, in
+            # advance order) into the replay interval.
+            self._pack_meta.append((gidx, valid))
         return xs
 
     def advance(
@@ -384,7 +448,7 @@ class BatchedDeviceNFA:
         if (
             self.auto_drain
             and step_cap <= self.config.matches
-            and self._pend_accum + step_cap > self.config.matches
+            and self._occupancy_bound() + step_cap > self.config.matches
         ):
             # Ring would overflow in the worst case: pull the pending
             # matches off the device and clear the ring NOW, but decode
@@ -398,13 +462,63 @@ class BatchedDeviceNFA:
             self._processed_gidx = max(
                 self._processed_gidx, self._pack_hwms.popleft()
             )
+        if self.exact_replay:
+            if self._pack_meta:
+                entry = self._pack_meta.popleft()
+            else:
+                # Externally packed xs: pull the ledger from the device
+                # (a sync -- correctness over pipelining on this rare path).
+                entry = (np.asarray(xs["gidx"]), np.asarray(xs["valid"]))
+            if len(self._interval_packs) >= self.REPLAY_LEDGER_MAX_BATCHES:
+                if not self._interval_overflow:
+                    import warnings
+
+                    warnings.warn(
+                        "exact-replay event ledger exceeded "
+                        f"{self.REPLAY_LEDGER_MAX_BATCHES} batches without a "
+                        "drain; this interval degrades to collision "
+                        "detection only -- drain() more often to keep "
+                        "replay armed",
+                        RuntimeWarning,
+                    )
+                self._interval_overflow = True
+                self._interval_packs = []
+            else:
+                self._interval_packs.append(entry)
         import time as _time
 
         t0 = _time.perf_counter()
-        self.state, ys = self._advance(self.state, xs)
+        try:
+            self.state, ys = self._advance(self.state, xs)
+        except Exception as exc:
+            if (
+                not (self.engine == "pallas" and self._engine_auto)
+                or self._batches > 0
+                or isinstance(exc, ValueError)
+            ):
+                # Only first-use, non-input-validation failures qualify:
+                # ValueError is the advance's own argument checking (a
+                # caller bug to surface, not a kernel limitation), and a
+                # kernel that already ran cannot "fail to compile".
+                raise
+            # Auto-selected kernel failed to build/compile (tracing and XLA
+            # compilation are synchronous, so failures surface here, before
+            # any state was mutated): fall back to the XLA scan step.
+            import warnings
+
+            self.engine = "xla"
+            self.engine_fallback_reason = (
+                f"pallas kernel failed, fell back to xla: {exc}"[:300]
+            )
+            warnings.warn(self.engine_fallback_reason)
+            self._advance = build_batched_advance(self.query, self.config)
+            self._post = build_batched_post(self.query, self.config)
+            self.state, ys = self._advance(self.state, xs)
         self.state, self.pool = self._post(self.state, self.pool, ys)
         self._batches += 1
         self._pend_accum += step_cap
+        if self.auto_drain:
+            self._dispatch_pos_probe()
         # Slot count from shape only -- counting true valids would pull the
         # device array and break the zero-sync advance path (exact event
         # totals live in the engine's n_events counter).
@@ -435,6 +549,24 @@ class BatchedDeviceNFA:
         if raw is not None:
             for k, v in self._decode_raw(raw).items():
                 out.setdefault(k, []).extend(v)
+        if self.exact_replay:
+            out = self._replay_boundary(out)
+        elif bool(self.query.agg_slots) and not self._warned_collisions:
+            # Replay is off but the query CAN diverge: surface the detector
+            # loudly instead of leaving it a silent counter in stats
+            # (the drain is already a sync point, so this pull is cheap).
+            if int(np.asarray(self.state["seq_collisions"]).sum()) > 0:
+                import warnings
+
+                self._warned_collisions = True
+                warnings.warn(
+                    "seq_collisions > 0 with exact_replay disabled: fold "
+                    "registers have diverged from the reference's per-run "
+                    "semantics for at least one key; matches may differ "
+                    "from the host oracle. Re-enable exact_replay (default) "
+                    "to recover exactness.",
+                    RuntimeWarning,
+                )
         # Prune AFTER decoding: the raw snapshot's chains reference events
         # by gidx, and materialized Sequences hold the Event objects.
         self._prune_events()  # registry must stay bounded on match-free streams
@@ -442,6 +574,114 @@ class BatchedDeviceNFA:
             _time.perf_counter() - t0, sum(len(v) for v in out.values())
         )
         return out
+
+    def _replay_boundary(
+        self, out: Dict[Any, List[Sequence]]
+    ) -> Dict[Any, List[Sequence]]:
+        """Drain-boundary exact-replay hook (ops/replay.py): keys whose
+        fold-divergence counter moved since the last boundary replay their
+        interval through the host oracle; the oracle's matches replace the
+        device's for those keys and the device state resyncs."""
+        import warnings
+
+        cur = np.asarray(self.state["seq_collisions"]).astype(np.int64)
+        hot = np.nonzero(cur > self._collision_base[: cur.shape[0]])[0]
+        if hot.size and self._interval_overflow:
+            import warnings
+
+            warnings.warn(
+                "fold-divergence detected but the replay ledger overflowed "
+                "this interval; affected keys' matches are engine-computed "
+                "(not oracle-replayed) for this interval only",
+                RuntimeWarning,
+            )
+        if hot.size and self._interval_packs and not self._interval_overflow:
+            from ..ops.replay import device_to_oracle, oracle_to_device
+
+            snap_state, snap_pool = self._snap
+            ts_base = self._ts_base if self._ts_base is not None else 0
+            counter_names = (
+                "n_events", "n_branches", "n_expired", "lane_drops",
+                "node_drops", "match_drops", "seq_collisions",
+            )
+            for k in hot.tolist():
+                if k >= len(self.keys):
+                    continue  # padding lanes never see valid events
+                key = self.keys[k]
+                sl_state = {
+                    n: np.asarray(v[..., k]) for n, v in snap_state.items()
+                }
+                sl_pool = {
+                    n: np.asarray(snap_pool[n][..., k])
+                    for n in ("node_event", "node_name", "node_pred", "node_count")
+                }
+                try:
+                    oracle, ev_gidx = device_to_oracle(
+                        self.query, self.config, sl_state, sl_pool,
+                        self._events, ts_base, key,
+                    )
+                except KeyError as exc:
+                    warnings.warn(
+                        f"exact-replay skipped for key {key!r}: snapshot "
+                        f"event {exc} missing from the registry"
+                    )
+                    continue
+                matches: List[Sequence] = []
+                for g_arr, v_arr in self._interval_packs:
+                    if k >= g_arr.shape[1]:
+                        continue  # batch packed before this key was added
+                    for t in range(g_arr.shape[0]):
+                        if v_arr[t, k]:
+                            g = int(g_arr[t, k])
+                            e = self._events[g]
+                            ev_gidx[e] = g
+                            matches.extend(oracle.match_pattern(e))
+                self.replays += 1
+                if matches:
+                    out[key] = matches
+                else:
+                    out.pop(key, None)
+                counters = {
+                    n: np.asarray(self.state[n][..., k]) for n in counter_names
+                }
+                try:
+                    new_state, new_pool = oracle_to_device(
+                        self.query, self.config, oracle, key, ev_gidx,
+                        ts_base, counters,
+                    )
+                    self._write_key_state(k, new_state, new_pool)
+                except (ValueError, KeyError) as exc:
+                    warnings.warn(
+                        f"exact-replay resync failed for key {key!r} "
+                        f"({exc}); device state kept -- this interval is "
+                        "oracle-exact but later ones fall back to detection"
+                    )
+        self._collision_base = cur
+        self._snap = (self.state, self.pool)
+        self._interval_packs = []
+        self._interval_overflow = False
+        return out
+
+    def _write_key_state(
+        self,
+        k: int,
+        new_state: Dict[str, np.ndarray],
+        new_pool: Dict[str, np.ndarray],
+    ) -> None:
+        """Write one key's resynced slices back into the [.., K] leaves."""
+        for name, val in new_state.items():
+            leaf = self.state[name]
+            self.state[name] = leaf.at[..., k].set(
+                jnp.asarray(val, leaf.dtype)
+            )
+        for name, val in new_pool.items():
+            leaf = self.pool[name]
+            self.pool[name] = leaf.at[..., k].set(
+                jnp.asarray(val, leaf.dtype)
+            )
+        if self.mesh is not None:
+            self.state = shard_state(self.state, self.mesh)
+            self.pool = shard_state(self.pool, self.mesh)
 
     # --------------------------------------------------------- checkpointing
     def snapshot(self) -> bytes:
@@ -480,14 +720,14 @@ class BatchedDeviceNFA:
 
         from ..state.serde import (
             _Reader,
-            MAGIC,
             decode_array_tree,
             decode_event_registry,
+            read_magic,
+            upgrade_pool_tree,
         )
 
         r = _Reader(data)
-        if r._read(4) != MAGIC:
-            raise ValueError("bad checkpoint magic")
+        read_magic(r)
         keys = pickle.loads(r.blob())
         bat = cls(
             stages_or_query, keys=keys, schema=schema, config=config,
@@ -495,7 +735,7 @@ class BatchedDeviceNFA:
         )
         tree = decode_array_tree(r.blob())
         state = {k: jnp.asarray(v) for k, v in tree.items()}
-        pool_tree = decode_array_tree(r.blob())
+        pool_tree = upgrade_pool_tree(decode_array_tree(r.blob()))
         pool = {k: jnp.asarray(v) for k, v in pool_tree.items()}
         if mesh is not None:
             state = shard_state(state, mesh)
@@ -530,6 +770,11 @@ class BatchedDeviceNFA:
         ts_base = r.i64()
         bat._ts_base = None if ts_base < 0 else ts_base
         bat._batches = r.i64()
+        if bat.exact_replay:
+            bat._snap = (bat.state, bat.pool)
+            bat._collision_base = np.asarray(
+                bat.state["seq_collisions"]
+            ).astype(np.int64)
         return bat
 
     # ------------------------------------------------------------ internals
@@ -552,6 +797,42 @@ class BatchedDeviceNFA:
         self._native_mod = mod
         return mod
 
+    def _dispatch_pos_probe(self) -> None:
+        """Start an async device->host copy of the ring cursor maximum."""
+        if self._pos_max_fn is None:
+            self._pos_max_fn = jax.jit(lambda p: jnp.max(p))
+        arr = self._pos_max_fn(self.pool["pend_pos"])
+        try:
+            arr.copy_to_host_async()
+        except Exception:
+            pass  # probe still resolves at is_ready()/int() time
+        self._pos_probes.append((self._drain_epoch, self._pend_accum, arr))
+
+    def _occupancy_bound(self) -> int:
+        """Worst-case ring occupancy: the freshest completed cursor probe
+        plus the page caps of every advance since it (falls back to the
+        pure worst-case accumulator while no probe has landed)."""
+        while self._pos_probes:
+            epoch, acc, arr = self._pos_probes[0]
+            try:
+                if not arr.is_ready():
+                    break
+            except AttributeError:
+                break  # runtime without is_ready(): keep worst-case bound
+            self._pos_probes.popleft()
+            if epoch == self._drain_epoch:
+                self._pos_obs = (acc, int(arr))
+        if self._pos_obs is not None:
+            acc, pos = self._pos_obs
+            return pos + (self._pend_accum - acc)
+        return self._pend_accum
+
+    def _ring_cleared(self) -> None:
+        """The pend ring was just drained: invalidate in-flight probes."""
+        self._drain_epoch += 1
+        self._pos_obs = None
+        self._pend_accum = 0
+
     def _pull_raw(self) -> Optional[Dict[str, np.ndarray]]:
         """Pull pending matches + the node pools off the device and clear
         the ring (a sync point). Decode happens separately (`_decode_raw`)
@@ -569,6 +850,7 @@ class BatchedDeviceNFA:
         if counts.sum() == 0:
             if int(np.asarray(self.pool["pend_pos"]).max()) > 0:
                 self.pool = self._drain_pend(self.pool)  # reclaim hole pages
+            self._ring_cleared()
             return None
         max_nodes = int(np.asarray(self.pool["node_count"]).max())
         full_b = self.pool["node_event"].shape[0]
@@ -600,10 +882,43 @@ class BatchedDeviceNFA:
             "node_pred": np.asarray(self.pool["node_pred"][:Bb]).T,
         }
         self.pool = self._drain_pend(self.pool)
+        self._ring_cleared()
         return raw
 
+    def _native_decoder(self):
+        """The C match decoder module, or None (cached; test-overridable)."""
+        from ..native import cached_decoder
+
+        return cached_decoder(self)
+
     def _decode_raw(self, raw: Dict[str, np.ndarray]) -> Dict[Any, List[Sequence]]:
-        """Materialize a pulled snapshot into per-key Sequence lists."""
+        """Materialize a pulled snapshot into per-key Sequence lists.
+
+        The C decoder (native/decoder.cc) walks every chain and builds the
+        Sequence objects in one call (~30 us -> ~2 us per match); the numpy
+        + Python path below is the fallback and the semantic reference."""
+        qid_tab = self.query.qid_of_name_id
+        native = self._native_decoder()
+        if native is not None:
+            from ..core.sequence import Staged
+
+            per_key = native.decode_matches(
+                np.ascontiguousarray(raw["counts"], np.int32),
+                raw["pend"],
+                raw["node_event"],
+                raw["node_name"],
+                raw["node_pred"],
+                self.query.name_of_id,
+                self._events,
+                Staged,
+                Sequence,
+                None if qid_tab is None else np.ascontiguousarray(qid_tab, np.int32),
+            )
+            return {
+                self.keys[k]: seqs
+                for k, seqs in enumerate(per_key)
+                if seqs
+            }
         pend = raw["pend"]
         node_event = raw["node_event"]
         node_name = raw["node_name"]
@@ -635,9 +950,12 @@ class BatchedDeviceNFA:
             if not chain:
                 continue  # GC-dropped under overflow (node_drops counts it)
             key = self.keys[k_idx]
-            out.setdefault(key, []).append(
-                materialize_sequence(chain, self.query.name_of_id, self._events)
-            )
+            seq = materialize_sequence(chain, self.query.name_of_id, self._events)
+            if qid_tab is not None:
+                # Stacked-query attribution: chains never span queries.
+                out.setdefault(key, []).append((int(qid_tab[chain[0][0]]), seq))
+            else:
+                out.setdefault(key, []).append(seq)
         return out
 
     def _prune_events(self) -> None:

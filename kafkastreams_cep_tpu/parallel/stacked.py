@@ -1,0 +1,97 @@
+"""Stacked multi-query driver: Q concurrent queries, one device program.
+
+The reference attaches one processor node per query to the same topic
+(reference: core/.../kstream/internals/CEPStreamImpl.java:80-93), so N
+concurrent queries cost N per-record NFA walks over the same events. The
+TPU-native form (SURVEY.md section 2.8 "stacked transition tables") compiles
+every query into ONE table set (ops/tables.py compile_multi_query): the
+event columns pack once, one begin lane per query seeds the shared lane
+pool, and a single batched advance serves all queries -- the per-event cost
+grows only with the union stage table and the extra live lanes, not with a
+full per-query engine replication.
+
+Matches route back to their owning query by the chain's stage-name id
+(`qid_of_name_id`); per-query outputs are bit-identical to running each
+query on its own engine (tests/test_stacked.py pins the equivalence).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence as Seq, Tuple
+
+from ..core.event import Event
+from ..core.sequence import Sequence
+from ..ops.engine import EngineConfig
+from ..ops.schema import EventSchema
+from ..ops.tables import compile_multi_query
+from .batched import BatchedDeviceNFA
+
+
+class StackedQueryEngine:
+    """Q queries x K keys advanced as one [T, K] device program.
+
+    API mirrors BatchedDeviceNFA; outputs are nested per key, then per
+    query name: `{key: {query_name: [Sequence, ...]}}`.
+    """
+
+    def __init__(
+        self,
+        named_queries: List[Tuple[str, Any]],
+        keys: Seq[Any],
+        schema: Optional[EventSchema] = None,
+        config: Optional[EngineConfig] = None,
+        mesh: Optional[Any] = None,
+        engine: str = "auto",
+        auto_drain: bool = True,
+    ) -> None:
+        self.query = compile_multi_query(named_queries, schema)
+        self.query_names: List[str] = list(self.query.query_names or [])
+        self.engine = BatchedDeviceNFA(
+            self.query,
+            keys=keys,
+            config=config,
+            mesh=mesh,
+            engine=engine,
+            auto_drain=auto_drain,
+        )
+
+    # ------------------------------------------------------------------ API
+    def pack(self, events_by_key: Mapping[Any, Seq[Event]]):
+        return self.engine.pack(events_by_key)
+
+    def advance(
+        self, events_by_key: Mapping[Any, Seq[Event]]
+    ) -> Dict[Any, Dict[str, List[Sequence]]]:
+        return self._split(self.engine.advance(events_by_key))
+
+    def advance_packed(self, xs, decode: bool = True):
+        return self._split(self.engine.advance_packed(xs, decode=decode))
+
+    def drain(self) -> Dict[Any, Dict[str, List[Sequence]]]:
+        return self._split(self.engine.drain())
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return self.engine.stats
+
+    @property
+    def timings(self):
+        return self.engine.timings
+
+    def snapshot(self) -> bytes:
+        return self.engine.snapshot()
+
+    # ----------------------------------------------------------- internals
+    def _split(
+        self, out: Dict[Any, List[Tuple[int, Sequence]]]
+    ) -> Dict[Any, Dict[str, List[Sequence]]]:
+        split: Dict[Any, Dict[str, List[Sequence]]] = {}
+        for key, pairs in out.items():
+            per_q = split.setdefault(key, {})
+            for qid, seq in pairs:
+                name = (
+                    self.query_names[qid]
+                    if 0 <= qid < len(self.query_names)
+                    else str(qid)
+                )
+                per_q.setdefault(name, []).append(seq)
+        return split

@@ -32,6 +32,59 @@ from .nfa_store import NFAStates, NFAStore
 
 MAGIC = b"KCT4"  # format tag + version (4: paged pend ring -- pool carries
                  # pend_pos + pinned leaves; 3: batched leaves key-axis-last)
+#: still-readable prior versions: KCT3 differs only by the pool's missing
+#: pend_pos/pinned leaves, which `upgrade_pool_tree` synthesizes on load.
+COMPAT_MAGIC = (b"KCT3",)
+
+
+def read_magic(r: "_Reader") -> int:
+    """Consume and validate the 4-byte format tag; returns its version."""
+    tag = r._read(4)
+    if tag == MAGIC:
+        return int(MAGIC[3:].decode())
+    if tag in COMPAT_MAGIC:
+        return int(tag[3:].decode())
+    raise ValueError("bad checkpoint magic")
+
+
+def upgrade_pool_tree(pool: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Upgrade a KCT3 engine pool in place: synthesize the paged-ring
+    cursor (`pend_pos` = one past the last occupied slot -- KCT3 rings are
+    compact prefixes) and the `pinned` bitmap (the pend-reachable closure,
+    re-walked host-side so pending chains survive the next GC)."""
+    if "pend_pos" in pool:
+        return pool
+    pend = np.asarray(pool["pend"])
+    pred = np.asarray(pool["node_pred"])
+    B = pred.shape[0]
+    valid = pend >= 0
+
+    def closure(pend_k: np.ndarray, pred_k: np.ndarray) -> np.ndarray:
+        pinned = np.zeros(B, bool)
+        cur = pend_k[(pend_k >= 0) & (pend_k < B)]
+        while cur.size:
+            cur = np.unique(cur)
+            new = cur[~pinned[cur]]
+            if new.size == 0:
+                break
+            pinned[new] = True
+            nxt = pred_k[new]
+            cur = nxt[(nxt >= 0) & (nxt < B)]
+        return pinned
+
+    if pend.ndim == 1:
+        pos = int(valid.nonzero()[0].max()) + 1 if valid.any() else 0
+        pool["pend_pos"] = np.asarray(pos, np.int32)
+        pool["pinned"] = closure(pend, pred)
+    else:  # batched: key axis last ([M, K] ring, [B, K] pool)
+        M, K = pend.shape
+        pos = np.where(valid.any(0), M - np.argmax(valid[::-1], 0), 0)
+        pool["pend_pos"] = pos.astype(np.int32)
+        pinned = np.zeros((B, K), bool)
+        for k in range(K):
+            pinned[:, k] = closure(pend[:, k], pred[:, k])
+        pool["pinned"] = pinned
+    return pool
 
 
 def _default_serialize(obj: Any) -> bytes:
@@ -208,8 +261,7 @@ class CheckpointCodec:
         from ..nfa.nfa import ComputationStage
 
         r = _Reader(data)
-        if r._read(4) != MAGIC:
-            raise ValueError("bad checkpoint magic")
+        read_magic(r)
         n = r.i32()
         queue = []
         for _ in range(n):
@@ -259,8 +311,7 @@ class CheckpointCodec:
 
     def decode_buffer(self, data: bytes) -> SharedVersionedBuffer:
         r = _Reader(data)
-        if r._read(4) != MAGIC:
-            raise ValueError("bad checkpoint magic")
+        read_magic(r)
         buffer: SharedVersionedBuffer = SharedVersionedBuffer()
         buffer._next_id = r.i64()
         n = r.i32()
@@ -291,8 +342,7 @@ class CheckpointCodec:
 
     def decode_aggregates(self, data: bytes) -> AggregatesStore:
         r = _Reader(data)
-        if r._read(4) != MAGIC:
-            raise ValueError("bad checkpoint magic")
+        read_magic(r)
         store = AggregatesStore()
         for _ in range(r.i32()):
             key = self._de(r.blob())
@@ -330,8 +380,7 @@ class CheckpointCodec:
         self, data: bytes
     ) -> Tuple[NFAStore, BufferStore, AggregatesStore]:
         r = _Reader(data)
-        if r._read(4) != MAGIC:
-            raise ValueError("bad checkpoint magic")
+        read_magic(r)
         nfa_store = NFAStore()
         for _ in range(r.i32()):
             key = self._de(r.blob())

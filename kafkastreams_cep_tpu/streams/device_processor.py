@@ -69,6 +69,8 @@ class DeviceCEPProcessor(Generic[K, V]):
         self._next_lane = 0
         self._pending: Dict[Any, List[Event]] = {}
         self._pending_count = 0
+        self._flushes = 0
+        self._warned_low_keys = False
         # Per-(key, topic#partition) high-water mark (CEPProcessor.java:152-160;
         # per-partition for the same reason as streams/processor.py).
         self._hwm: Dict[Tuple[Any, str], int] = {}
@@ -100,10 +102,31 @@ class DeviceCEPProcessor(Generic[K, V]):
             return self.flush()
         return []
 
+    #: flush count after which a persistently tiny key population triggers
+    #: the runtime-choice warning (the device engine's parallelism axis is
+    #: keys; K~1 runs an order of magnitude slower than runtime="host").
+    LOW_KEY_WARN_FLUSHES = 10
+
     def flush(self) -> List[Tuple[K, Sequence[K, V]]]:
         """Drive the pending micro-batch through the device engine."""
         if not self._pending:
             return []
+        self._flushes += 1
+        if (
+            not self._warned_low_keys
+            and self._flushes >= self.LOW_KEY_WARN_FLUSHES
+            and self._next_lane <= 2
+        ):
+            import warnings
+
+            self._warned_low_keys = True
+            warnings.warn(
+                f"DeviceCEPProcessor has seen only {self._next_lane} "
+                "distinct key(s): the device engine parallelizes across "
+                "keys, and low-cardinality streams run ~10x faster on "
+                'runtime="host" (see README "Choosing a runtime")',
+                RuntimeWarning,
+            )
         batch: Dict[_Lane, List[Event]] = {}
         for key, events in self._pending.items():
             batch[self._lane_for(key)] = events
@@ -152,15 +175,14 @@ class DeviceCEPProcessor(Generic[K, V]):
     ) -> "DeviceCEPProcessor":
         import pickle
 
-        from ..state.serde import _Reader, MAGIC, decode_event_registry
+        from ..state.serde import _Reader, decode_event_registry, read_magic
 
         proc = cls(
             query_name, pattern_or_query, schema=schema, config=config,
             batch_size=batch_size, mesh=mesh,
         )
         r = _Reader(data)
-        if r._read(4) != MAGIC:
-            raise ValueError("bad checkpoint magic")
+        read_magic(r)
         proc.engine = BatchedDeviceNFA.restore(
             proc.query, r.blob(), config=proc.config, mesh=mesh
         )

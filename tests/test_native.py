@@ -169,3 +169,104 @@ def test_native_pack_float_ts_and_dict_subclass_parity():
                 np.asarray(nxs[name]), np.asarray(pxs[name]), err_msg=name
             )
     assert np.asarray(nat_xs[0]["f:price"]).ravel()[:3].tolist() == [999] * 3
+
+
+# ---------------------------------------------------------------- decoder
+def _decode_both(pattern_events):
+    """Run the same stream through two engines, one with the C decoder and
+    one forced onto the Python decode path; return both match dicts."""
+    from kafkastreams_cep_tpu.native import load_decoder
+
+    if load_decoder() is None:
+        pytest.skip("native decoder unavailable (no compiler?)")
+    query_fn, streams, cfg = pattern_events
+    keys = sorted(streams)
+    outs = []
+    for use_native in (True, False):
+        bat = BatchedDeviceNFA(query_fn(), keys=keys, config=cfg)
+        if not use_native:
+            bat._native_dec = None  # force the Python reference path
+        got = {}
+        n = max(len(s) for s in streams.values())
+        for b in range(0, n, 16):
+            chunk = {k: s[b : b + 16] for k, s in streams.items()}
+            for k, v in bat.advance(chunk).items():
+                got.setdefault(k, []).extend(v)
+        assert (bat._native_decoder() is not None) == use_native
+        outs.append(got)
+    return outs
+
+
+def test_native_decode_parity_branchy():
+    """one_or_more chains (shared prefixes, multi-event groups) decode
+    identically through decoder.cc and the Python reference."""
+    import random
+
+    from kafkastreams_cep_tpu import QueryBuilder
+    from kafkastreams_cep_tpu.pattern.expressions import value
+
+    def query_fn():
+        pattern = (
+            QueryBuilder()
+            .select("first").one_or_more().where(value() == "C")
+            .then().select("latest").where(value() == "D")
+            .build()
+        )
+        return compile_query(compile_pattern(pattern), None)
+
+    rng = random.Random(3)
+    streams = {
+        f"k{i}": _mk_events(f"k{i}", [rng.choice("CCDX") for _ in range(64)])
+        for i in range(8)
+    }
+    cfg = EngineConfig(lanes=32, nodes=1024, matches=512, matches_per_step=16)
+    nat, py = _decode_both((query_fn, streams, cfg))
+    assert nat == py
+    assert sum(len(v) for v in nat.values()) > 50  # real match volume
+
+
+def test_native_decode_parity_single_key_runtime():
+    """The single-key DeviceNFA drain routes through the same C decoder."""
+    from kafkastreams_cep_tpu.native import load_decoder
+    from kafkastreams_cep_tpu.ops.runtime import DeviceNFA
+
+    if load_decoder() is None:
+        pytest.skip("native decoder unavailable (no compiler?)")
+    events = _mk_events("k", list("ABCABC"))
+    dn = DeviceNFA(_letters_query(), config=EngineConfig(lanes=8, nodes=64, matches=16))
+    dpy = DeviceNFA(_letters_query(), config=EngineConfig(lanes=8, nodes=64, matches=16))
+    dpy._native_dec = None
+    a = dn.advance(list(events))
+    b = dpy.advance(list(events))
+    assert a == b and len(a) == 2
+    assert dn._native_decoder() is not None
+
+
+def test_native_decode_unnormalized_group_falls_back():
+    """Events arriving with non-increasing offsets inside one stage group
+    must still decode through Staged's sorted(set()) normalization --
+    the C fast path may only skip it when provably normalized."""
+    from kafkastreams_cep_tpu import QueryBuilder
+    from kafkastreams_cep_tpu.pattern.expressions import value
+
+    def query_fn():
+        pattern = (
+            QueryBuilder()
+            .select("first").one_or_more().where(value() == "C")
+            .then().select("latest").where(value() == "D")
+            .build()
+        )
+        return compile_query(compile_pattern(pattern), None)
+
+    # Offsets DECREASE within the stream: groups with >1 event are
+    # un-normalized, so the C decoder must take the Python-constructor path.
+    evs = [
+        Event("k", v, 1000 + i, "t", 0, 100 - i)
+        for i, v in enumerate(["C", "C", "D"])
+    ]
+    nat, py = _decode_both((query_fn, {"k": evs}, EngineConfig(lanes=16, nodes=256, matches=64)))
+    assert nat == py
+    some = next(iter(nat.values()))[0]
+    first = some.get_by_name("first")
+    offs = [e.offset for e in first.events]
+    assert offs == sorted(offs), "Staged normalization lost"

@@ -55,3 +55,65 @@ def test_match_across_restore():
         ("select-B", ["B"]),
         ("select-C", ["C"]),
     ]
+
+
+def test_device_processor_warns_on_low_key_cardinality():
+    """runtime-choice guidance made operational (README "Choosing a
+    runtime"): a persistently ~single-key stream on the device processor
+    warns once that runtime="host" is faster."""
+    import warnings as _warnings
+
+    import pytest
+
+    from kafkastreams_cep_tpu.streams.device_processor import DeviceCEPProcessor
+
+    pattern = (
+        QueryBuilder()
+        .select("a").where(value() == "A")
+        .then().select("b").where(value() == "B")
+        .build()
+    )
+    proc = DeviceCEPProcessor("q", pattern, batch_size=2)
+    with pytest.warns(RuntimeWarning, match="distinct key"):
+        for i in range(2 * DeviceCEPProcessor.LOW_KEY_WARN_FLUSHES + 2):
+            proc.process("only-key", "A" if i % 2 else "B", timestamp=i, offset=i)
+    # ...and only once.
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        for i in range(100, 104):
+            proc.process("only-key", "A", timestamp=i, offset=i)
+        proc.flush()
+
+
+def test_batched_warns_on_collisions_without_replay():
+    """With exact_replay off, a fired fold-divergence detector must surface
+    as a warning at drain, not stay a silent counter (VERDICT r4 weak #6)."""
+    import random
+
+    import pytest
+    from test_differential import ALPHABET, _branchy_pattern
+
+    from kafkastreams_cep_tpu import Event, compile_pattern
+    from kafkastreams_cep_tpu.ops.engine import EngineConfig
+    from kafkastreams_cep_tpu.ops.tables import compile_query
+    from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA
+
+    # The hunted colliding shape (differential seed 72).
+    rng = random.Random(50_072)
+    pattern = _branchy_pattern(rng)
+    events = []
+    ts = 1000
+    for i in range(20):
+        ts += rng.choice([0, 1, 1, 2])
+        events.append(Event("k", rng.choice(ALPHABET), ts, "t", 0, i))
+    bat = BatchedDeviceNFA(
+        compile_query(compile_pattern(pattern), None),
+        keys=["k"],
+        config=EngineConfig(lanes=256, nodes=4096, matches=2048,
+                            matches_per_step=256),
+        exact_replay=False,
+    )
+    with pytest.warns(RuntimeWarning, match="seq_collisions"):
+        for b in range(0, 20, 5):
+            bat.advance({"k": events[b : b + 5]})
+    assert bat.stats["seq_collisions"] > 0
