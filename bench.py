@@ -333,21 +333,23 @@ def bench_device_batched(
         engine=ARGS.engine,
     )
     rng = random.Random(7)
-    n_lat = 4  # extra batches for the per-batch latency pass
-    total_b = n_batches + n_lat
+    n_lat = 4   # extra batches for the per-batch latency pass
+    n_e2e = max(n_batches - 1, 1)  # batches for the interleaved-ingest pass
+    total_b = n_batches + n_lat + n_e2e
     streams = {k: stream_fn(rng, batch * total_b) for k in bat.keys}
 
     t_pack0 = time.perf_counter()
     packed = [
         bat.pack({k: s[b * batch: (b + 1) * batch] for k, s in streams.items()})
-        for b in range(total_b)
+        for b in range(n_batches)
     ]
     pack_s = time.perf_counter() - t_pack0
 
     bat.advance_packed(packed[0], decode=True)  # warmup compiles advance+gc+drain
     jax.block_until_ready(bat.state["n_events"])
 
-    # Throughput pass: fully pipelined -- no per-batch sync, one drain.
+    # Throughput pass (engine-only): batches pre-packed, no per-batch sync,
+    # one drain at the end.
     t0 = time.perf_counter()
     for xs in packed[1:n_batches]:
         bat.advance_packed(xs, decode=False)
@@ -357,16 +359,39 @@ def bench_device_batched(
     dt = time.perf_counter() - t0
     n = (n_batches - 1) * batch * n_keys
 
+    # End-to-end pass: pack + advance interleaved on one thread. Dispatch
+    # is async, so packing batch b+1 overlaps the device computing batch b
+    # (pipelined ingest) -- this is the number a production driver sees,
+    # ingest included. The per-batch event dicts are sliced up front: the
+    # synthetic stream generator is not part of the system under test.
+    e2e_chunks = [
+        {k: s[b * batch: (b + 1) * batch] for k, s in streams.items()}
+        for b in range(n_batches, n_batches + n_e2e)
+    ]
+    t0 = time.perf_counter()
+    for chunk in e2e_chunks:
+        bat.advance_packed(bat.pack(chunk), decode=False)
+    jax.block_until_ready(bat.state["n_events"])
+    e2e_matches = sum(len(v) for v in bat.drain().values())
+    e2e_dt = time.perf_counter() - t0
+    e2e_n = n_e2e * batch * n_keys
+
     # Latency pass: decode + block every batch. BatchTimings turns these
     # per-batch drains into the BASELINE.md match-emit latency metric
     # (advance dispatch -> drain return); reset so the summary covers only
-    # this pass, not the throughput pass's single deferred drain.
+    # this pass, not the earlier passes' deferred drains (whose first call
+    # also compiled the pull/decode programs -- warmed above, so no compile
+    # time pollutes the percentiles).
     from kafkastreams_cep_tpu.ops.profiling import BatchTimings
 
+    lat_packed = [
+        bat.pack({k: s[b * batch: (b + 1) * batch] for k, s in streams.items()})
+        for b in range(n_batches + n_e2e, total_b)
+    ]
     bat.timings = BatchTimings()
     lat_ms: List[float] = []
     lat_matches = 0
-    for xs in packed[n_batches:]:
+    for xs in lat_packed:
         tb = time.perf_counter()
         out = bat.advance_packed(xs, decode=True)
         lat_matches += sum(len(v) for v in out.values())
@@ -377,9 +402,10 @@ def bench_device_batched(
     stats = bat.stats
     return dict(
         events=n, seconds=dt, eps=n / dt, matches=n_matches,
+        e2e_eps=e2e_n / e2e_dt, e2e_matches=e2e_matches,
         lat_matches=lat_matches,
         keys=n_keys, batch=batch, lanes=config.lanes, engine=bat.engine,
-        pack_eps=total_b * batch * n_keys / pack_s,
+        pack_eps=n_batches * batch * n_keys / pack_s,
         p50_batch_ms=float(np.percentile(lat_ms, 50)),
         p99_batch_ms=float(np.percentile(lat_ms, 99)),
         p50_match_emit_ms=lat_summary.get("emit_latency_ms_p50"),
@@ -544,8 +570,12 @@ def main() -> None:
             skip_any8_pattern, None, skip_any8_stream,
             # Sized for ZERO drop counters at K=2048 (lane/node/match):
             # zero silent loss is part of the contract, not a footnote
-            # (PERF.md "Capacity policy").
-            EngineConfig(lanes=256, nodes=1024, matches=8192,
+            # (PERF.md "Capacity policy"). The 16k ring absorbs the whole
+            # pass's pages, so no mid-pass host drain fires; the GC's
+            # prefix-bucketed remap keeps the big ring nearly free.
+            # nodes=2048: deferring every drain to pass-end pins the whole
+            # pass's match chains in the region at once.
+            EngineConfig(lanes=256, nodes=2048, matches=16384,
                          matches_per_step=32, nodes_per_step=32,
                          strict_windows=True),
             n_keys, bb, nb,
@@ -568,7 +598,12 @@ def main() -> None:
         # (r03 silently discarded half its matches; see PERF.md).
         detail["stock_rising_batched"] = bench_device_batched(
             stock_pattern, stock_schema, stock_stream,
-            EngineConfig(lanes=512, nodes=4096, matches=24576,
+            # matches = 2 pages: the >1-match-per-event regime fills a
+            # 24576-slot page per advance, but true counts are ~67/key per
+            # batch -- the guard's on-device hole compaction keeps the
+            # ring live across the pass instead of a sync host drain per
+            # batch.
+            EngineConfig(lanes=512, nodes=4096, matches=49152,
                          matches_per_step=384, nodes_per_step=384),
             (ARGS.keys or (8 if quick else 512)), bb, nb,
         )

@@ -833,52 +833,63 @@ def build_pend_append(config: EngineConfig):
         rest = w_match.shape[2:]
         ids = w_match.reshape((TM,) + rest)
         if TM > M or not rest:
-            # Oversized pages can't ride the ring; and the single-key pool
-            # ([M], no key axis) always compacts -- hole pages would shrink
-            # its deferred-decode capacity from `matches` matches to
-            # matches/page pages, and at K=1 the compact path's sort and
-            # O(M) placement are trivial anyway.
+            # Oversized pages can't ride the scatter (every slot may be
+            # real); and the single-key pool ([M], no key axis) is trivial
+            # at the compact path's O(M) arithmetic.
             return append_compact(state, pool, ids)
         pend = pool["pend"]
-        pos_leaf = pool["pend_pos"]
-        # Page start: the max cursor across keys. After paged appends the
-        # cursor is uniform; after a compact append it may be ragged --
-        # starting at the max never clobbers any key's entries and keeps
-        # position order == emission order.
-        pos0 = jnp.max(pos_leaf) if pos_leaf.ndim else pos_leaf
-        fits = pos0 + TM <= M
-        start = jnp.minimum(pos0, M - TM)
-        zeros = (0,) * len(rest)
-        cur = jax.lax.dynamic_slice(
-            pend, (start,) + zeros, (TM,) + pend.shape[1:]
-        )
-        page = jnp.where(fits, ids, cur)
-        new_pend = jax.lax.dynamic_update_slice(pend, page, (start,) + zeros)
-        n_valid = jnp.sum((ids >= 0).astype(jnp.int32), axis=0)  # [K] or ()
-        added = jnp.where(fits, n_valid, 0)
-        # All-hole pages (no key matched this advance -- the common case in
-        # sparse CEP) must not consume ring capacity.
-        any_valid = jnp.sum(n_valid) > 0
+        pos = pool["pend_pos"]  # [K] per-key TRUE counts (no holes)
+        # Dense scatter-append: each key's valid ids land at its own
+        # cursor, in emission order (the page is t-major and each step's
+        # match slots are a rank-ordered prefix, so the running count IS
+        # the emission rank). No hole pages: ring occupancy equals the
+        # true match count, so the GC's prefix-bucketed remap and the
+        # drain guard track real match volume, not page burn. (An earlier
+        # design appended whole fixed pages with holes at a uniform
+        # cursor; sparse streams then hit ring-capacity syncs every
+        # M/page advances and the GC remapped hole rows every advance --
+        # honest-timing notes in PERF.md "v7".)
+        m_valid = ids >= 0
+        csum = jnp.cumsum(m_valid.astype(jnp.int32), axis=0)
+        n_valid = csum[-1]                                   # [K]
+        rank = csum - m_valid.astype(jnp.int32)
+        target = pos[None, :] + rank                         # [TM, K]
+        placed_m = m_valid & (target < M)
+        kk = jnp.arange(ids.shape[1])[None, :]
+        # mode="drop" discards out-of-range rows (hole slots route to M).
+        new_pend = pend.at[
+            jnp.where(placed_m, target, M), kk
+        ].set(jnp.where(placed_m, ids, -1), mode="drop")
+        placed = jnp.minimum(jnp.maximum(M - pos, 0), n_valid)
         new_pool = {
             **pool,
             "pend": new_pend,
-            "pend_count": pool["pend_count"] + added,
-            "pend_pos": jnp.broadcast_to(
-                jnp.where(fits & any_valid, pos0 + TM, pos0), pos_leaf.shape
-            ).astype(jnp.int32),
+            "pend_count": pool["pend_count"] + placed,
+            "pend_pos": (pos + placed).astype(jnp.int32),
         }
         new_state = {
             **state,
-            "match_drops": state["match_drops"] + (n_valid - added),
+            "match_drops": state["match_drops"] + (n_valid - placed),
         }
-        page_roots = jnp.where(fits, ids, -1)
+        page_roots = jnp.where(placed_m, ids, -1)
         return new_state, new_pool, page_roots
 
     return append
 
 
-def build_gc(query: CompiledQuery, config: EngineConfig):
+def build_gc(
+    query: CompiledQuery,
+    config: EngineConfig,
+    defer_pend_remap: bool = False,
+):
     """The per-key post-advance GC: pin-seeded mark + sweep compaction.
+
+    With `defer_pend_remap`, the pend ring is returned UNREMAPPED and the
+    per-key remap table is emitted as a third output: the batched post
+    wrapper then rewrites only the occupied ring prefix in a dynamic
+    block loop (`remap_pend_blocks`) -- the full-width value-remap gather
+    was the single most expensive op in the post pass (honest D2H-forced
+    timing, PERF.md "v7"), and only the device knows the true occupancy.
 
     Runs once per advance (not per event step):
 
@@ -966,7 +977,7 @@ def build_gc(query: CompiledQuery, config: EngineConfig):
             page_sm = page_roots.reshape(-1, m_step).T.reshape(TM_page)
         else:
             page_sm = page_roots
-        CHUNK = 256  # measured optimum on v5e (128/512/2048 all slower)
+        CHUNK = 256  # all-hole chunks exit their while_loop after one reduce
         marked_pin = marked0
         for c0 in range(0, TM_page, CHUNK):
             marked_pin = walk(marked_pin, page_sm[c0 : c0 + CHUNK])
@@ -991,12 +1002,16 @@ def build_gc(query: CompiledQuery, config: EngineConfig):
         pred_remapped = jnp.where(
             combined_pred >= 0, remap_full[combined_pred.clip(0)], -1
         )
+        if defer_pend_remap:
+            new_pend = pend  # rewritten by remap_pend_blocks in the wrapper
+        else:
+            new_pend = jnp.where(pend >= 0, remap_full[pend.clip(0)], -1)
         new_pool = {
             "node_event": jnp.where(ok, combined_event[sel], -1),
             "node_name": jnp.where(ok, combined_name[sel], -1),
             "node_pred": jnp.where(ok, pred_remapped[sel], -1),
             "node_count": jnp.minimum(n_keep, B),
-            "pend": jnp.where(pend >= 0, remap_full[pend.clip(0)], -1),
+            "pend": new_pend,
             "pend_count": pool["pend_count"],
             "pend_pos": pool["pend_pos"],
             "pinned": marked_pin[sel] & ok,
@@ -1009,9 +1024,53 @@ def build_gc(query: CompiledQuery, config: EngineConfig):
             "node_drops": state["node_drops"]
             + jnp.maximum(n_keep - B, 0).astype(jnp.int32),
         }
+        if defer_pend_remap:
+            return new_state, new_pool, remap_full
         return new_state, new_pool
 
     return gc
+
+
+def remap_pend_blocks(
+    pend: jnp.ndarray,      # [M, K] UNREMAPPED ring (dense prefix per key)
+    remap_full: jnp.ndarray,  # [BW + 1, K] per-key node-id remap tables
+    pend_pos: jnp.ndarray,  # [K] per-key occupancy cursors
+    block: int = 512,
+) -> jnp.ndarray:
+    """Value-remap the ring's occupied prefix in dynamic fixed-width
+    blocks: a device-side while_loop runs ceil(max(pend_pos) / block)
+    iterations, each remapping one [block, K] slice at a UNIFORM offset
+    (plain dynamic_slice/update, no per-key scatter). The remap cost then
+    tracks true ring occupancy -- which only the device knows once
+    dispatches run ahead of completions -- instead of ring capacity or a
+    host-side worst-case bound."""
+    M, K = pend.shape
+    w = min(block, M)
+    maxpos = jnp.max(pend_pos)
+    gather = jax.vmap(
+        lambda r, h: jnp.where(h >= 0, r[h.clip(0)], -1),
+        in_axes=-1, out_axes=-1,
+    )
+
+    def cond(carry):
+        i, _ = carry
+        return i * w < jnp.minimum(maxpos, M)
+
+    def body(carry):
+        i, p = carry
+        off_raw = i * w
+        # The final block clamps to the ring end; rows below off_raw were
+        # remapped by the previous iteration and must pass through
+        # untouched (a second remap would corrupt them).
+        off = jnp.minimum(off_raw, M - w)
+        head = jax.lax.dynamic_slice(p, (off, 0), (w, K))
+        fresh = (off + jnp.arange(w) >= off_raw)[:, None]
+        return i + 1, jax.lax.dynamic_update_slice(
+            p, jnp.where(fresh, gather(remap_full, head), head), (off, 0)
+        )
+
+    _, out = jax.lax.while_loop(cond, body, (jnp.int32(0), pend))
+    return out
 
 
 def build_post(query: CompiledQuery, config: EngineConfig):
@@ -1028,6 +1087,31 @@ def build_post(query: CompiledQuery, config: EngineConfig):
         return gc(state, pool, ys, page_roots)
 
     return post
+
+
+def compact_valid_front(ids: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stably move the valid (>= 0) entries of each key's column to the
+    front; returns (compacted, per-key counts).
+
+    Rank-scatter, not sort: TPU lowers a stable argsort over a major axis
+    to sort custom-calls measured ~3x the cost of the cumsum + one scatter
+    used here (honest D2H-forced timing; the broken-`block_until_ready`
+    micro-profiles that originally picked argsort are documented in
+    PERF.md "Measurement trap"). Hole entries scatter to a trash row that
+    is sliced off, so duplicate targets only ever carry -1.
+    """
+    m = ids >= 0
+    M = ids.shape[0]
+    c = jnp.cumsum(m.astype(jnp.int32), axis=0)
+    counts = c[-1]
+    rank = jnp.where(m, c - 1, M)  # holes -> trash row
+    out = jnp.full(ids.shape[:0] + (M + 1,) + ids.shape[1:], -1, ids.dtype)
+    if ids.ndim == 1:
+        out = out.at[rank].set(jnp.where(m, ids, -1))
+    else:
+        kk = jnp.arange(int(np.prod(ids.shape[1:]))).reshape(ids.shape[1:])
+        out = out.at[rank, kk].set(jnp.where(m, ids, -1))
+    return out[:M], counts
 
 
 def drain_pend(pool: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:

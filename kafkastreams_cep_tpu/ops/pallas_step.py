@@ -992,15 +992,19 @@ def build_pallas_batched_post(
     config: EngineConfig,
     mesh: Optional[Any] = None,
 ):
-    """Post pass (pend-page append + GC) for pallas-layout ys ([T, K, cap]).
+    """Post pass (dense scatter-append + GC) for pallas-layout ys
+    ([T, K, cap]).
 
     With `mesh`, runs under `shard_map` over the key axis like the advance
-    (the append offset and GC are per-key; no collectives)."""
-    from .engine import build_gc, build_pend_append
+    (the append offset and GC are per-key; no collectives). The ring
+    remap runs as a dynamic block loop over the occupied prefix
+    (engine.remap_pend_blocks)."""
+    from .engine import build_gc, build_pend_append, remap_pend_blocks
 
     append = build_pend_append(config)
     gc = jax.vmap(
-        build_gc(query, config), in_axes=(-1, -1, 1, -1), out_axes=(-1, -1)
+        build_gc(query, config, defer_pend_remap=True),
+        in_axes=(-1, -1, 1, -1), out_axes=(-1, -1, -1),
     )
 
     def post_impl(state, pool, ys):
@@ -1009,7 +1013,14 @@ def build_pallas_batched_post(
         state, pool, page_roots = append(
             state, pool, jnp.transpose(ys["w_match"], (0, 2, 1))
         )
-        return gc(state, pool, ys, page_roots)
+        state, pool, remap_full = gc(state, pool, ys, page_roots)
+        pool = {
+            **pool,
+            "pend": remap_pend_blocks(
+                pool["pend"], remap_full, pool["pend_pos"]
+            ),
+        }
+        return state, pool
 
     if mesh is None:
         return jax.jit(post_impl)

@@ -211,7 +211,7 @@ class DeviceNFA:
             and self._interval_events
             and not self._interval_overflow
         ):
-            matches = self._replay_interval()
+            matches = self._replay_interval(matches)
         self._collision_base = int(self.state["seq_collisions"])
         self._snap = (self.state, self.pool)
         self._interval_events = []
@@ -219,7 +219,9 @@ class DeviceNFA:
         self._interval_start_gidx = self._next_gidx
         return matches
 
-    def _replay_interval(self) -> List[Sequence]:
+    def _replay_interval(
+        self, engine_matches: List[Sequence]
+    ) -> List[Sequence]:
         import warnings
 
         from .replay import device_to_oracle, oracle_to_device
@@ -229,10 +231,21 @@ class DeviceNFA:
         snap_pool = {k: np.asarray(v) for k, v in self._snap[1].items()}
         key = self._interval_events[0].key
         ts_base = self._ts_base if self._ts_base is not None else 0
-        oracle, ev_gidx = device_to_oracle(
-            self.query, self.config, snap_state, snap_pool, self._events,
-            ts_base, key,
-        )
+        try:
+            oracle, ev_gidx = device_to_oracle(
+                self.query, self.config, snap_state, snap_pool, self._events,
+                ts_base, key,
+            )
+        except KeyError as exc:
+            # A snapshot event fell out of the registry (or a node was
+            # GC-dropped under region overflow): degrade to detection-only
+            # for this interval rather than crashing the drain -- the
+            # batched driver does the same (parallel/batched.py).
+            warnings.warn(
+                f"exact-replay skipped: snapshot event {exc} missing from "
+                "the registry; this interval's matches are engine-computed"
+            )
+            return engine_matches
         matches: List[Sequence] = []
         for i, e in enumerate(self._interval_events):
             ev_gidx[e] = self._interval_start_gidx + i

@@ -136,17 +136,21 @@ class BatchedDeviceNFA:
         #: explicit drain()/decoding advance.
         self.auto_drain = auto_drain
         self._pend_accum = 0
-        #: Async ring-cursor probes: after each advance a tiny jitted
+        #: Async ring probes: after each advance a tiny jitted
         #: max(pend_pos) reduction is dispatched and copied host-ward
         #: asynchronously; the guard reads the freshest COMPLETED one to
-        #: replace the worst-case occupancy bound with (observed cursor +
-        #: caps since the observation). Long match-free runs then never
-        #: force a no-op sync drain (round-4 advisory) -- the cursor only
-        #: moves on pages that actually hold a match.
+        #: replace the worst-case occupancy bound with (observed count +
+        #: per-advance caps since the observation). The dense scatter-
+        #: append keeps the ring hole-free, so the cursor IS the true
+        #: match count: sparse streams never force a no-op sync drain,
+        #: and a drain fires only when real match volume nears the ring.
         self._pos_probes: deque = deque()
-        self._pos_obs: Optional[Tuple[int, int]] = None  # (accum_at_obs, pos)
+        #: (accum_at_obs, pos) from the freshest completed probe.
+        self._pos_obs: Optional[Tuple[int, int]] = None
         self._drain_epoch = 0
         self._pos_max_fn = None
+        self._drain_compact_fn = None
+        self._drain_counts_fn = None
         self._auto_buffer: Dict[Any, List[Sequence]] = {}
         self._compact_pend_fn = None
         self.events_prune_threshold = events_prune_threshold
@@ -192,10 +196,10 @@ class BatchedDeviceNFA:
     def _pick_engine(self, engine: str) -> Tuple[str, Optional[str]]:
         """Resolve "auto" to the fused pallas kernel when it applies.
 
-        The kernel runs single-chip only (a mesh shards the XLA path);
-        "auto" keeps the XLA scan step for meshes, non-TPU platforms and
-        configs outside the kernel's envelope, recording why in
-        `engine_fallback_reason`.
+        The kernel runs on TPU, single-chip or shard_mapped over a mesh's
+        key axis (build_pallas_batched_advance); "auto" keeps the XLA scan
+        step for non-TPU platforms and configs outside the kernel's
+        envelope, recording why in `engine_fallback_reason`.
         """
         from ..ops.pallas_step import supports_pallas
 
@@ -445,19 +449,17 @@ class BatchedDeviceNFA:
         # engine's compact append places what fits and counts the rest in
         # match_drops (loud) -- size EngineConfig.matches to at least one
         # page (T * matches_per_step) for loss-free deferred decode.
-        if (
-            self.auto_drain
-            and step_cap <= self.config.matches
-            and self._occupancy_bound() + step_cap > self.config.matches
-        ):
-            # Ring would overflow in the worst case: pull the pending
-            # matches off the device and clear the ring NOW, but decode
-            # them host-side only after the next advance is dispatched --
-            # the Python materialization then overlaps device compute.
-            # Applies to decoding advances too: their own drain only runs
-            # after the advance has already appended to the ring.
-            raw = self._pull_raw()
-            self._pend_accum = 0
+        if self.auto_drain and step_cap <= self.config.matches:
+            if self._occupancy_bound() + step_cap > self.config.matches:
+                # Real matches approach the ring size (the dense append
+                # keeps occupancy == true count): pull them off the
+                # device and clear the ring NOW, but decode them
+                # host-side only after the next advance is dispatched --
+                # the materialization then overlaps device compute.
+                # Applies to decoding advances too: their own drain only
+                # runs after the advance appended to the ring.
+                raw = self._pull_raw()
+                self._pend_accum = 0
         if self._pack_hwms:
             self._processed_gidx = max(
                 self._processed_gidx, self._pack_hwms.popleft()
@@ -517,7 +519,10 @@ class BatchedDeviceNFA:
         self.state, self.pool = self._post(self.state, self.pool, ys)
         self._batches += 1
         self._pend_accum += step_cap
-        if self.auto_drain:
+        if self.auto_drain and step_cap <= self.config.matches:
+            # Probes only feed the capacity guard, which is inert in the
+            # compact-append regime (step_cap > matches): dispatching them
+            # there would grow _pos_probes without a consumer.
             self._dispatch_pos_probe()
         # Slot count from shape only -- counting true valids would pull the
         # device array and break the zero-sync advance path (exact event
@@ -558,13 +563,29 @@ class BatchedDeviceNFA:
             if int(np.asarray(self.state["seq_collisions"]).sum()) > 0:
                 import warnings
 
+                from ..ops.replay import supports_replay
+
                 self._warned_collisions = True
+                if supports_replay(self.query):
+                    remedy = (
+                        "Re-enable exact_replay (default) to recover "
+                        "exactness."
+                    )
+                else:
+                    # e.g. stacked multi-query tables carry no host stages:
+                    # telling the user to re-enable replay would be advice
+                    # that cannot work.
+                    remedy = (
+                        "This engine cannot replay (no host-stage oracle "
+                        "for this compiled query, e.g. stacked "
+                        "multi-query); run the affected query on its own "
+                        "engine for oracle-exact folds."
+                    )
                 warnings.warn(
-                    "seq_collisions > 0 with exact_replay disabled: fold "
-                    "registers have diverged from the reference's per-run "
-                    "semantics for at least one key; matches may differ "
-                    "from the host oracle. Re-enable exact_replay (default) "
-                    "to recover exactness.",
+                    "seq_collisions > 0 with exact replay unavailable: "
+                    "fold registers have diverged from the reference's "
+                    "per-run semantics for at least one key; matches may "
+                    "differ from the host oracle. " + remedy,
                     RuntimeWarning,
                 )
         # Prune AFTER decoding: the raw snapshot's chains reference events
@@ -810,8 +831,10 @@ class BatchedDeviceNFA:
 
     def _occupancy_bound(self) -> int:
         """Worst-case ring occupancy: the freshest completed cursor probe
-        plus the page caps of every advance since it (falls back to the
-        pure worst-case accumulator while no probe has landed)."""
+        plus the per-advance caps since it (falls back to the pure
+        worst-case accumulator while no probe has landed). Occupancy grows
+        by at most `step_cap` per advance, so adding the caps-since keeps
+        this an upper bound."""
         while self._pos_probes:
             epoch, acc, arr = self._pos_probes[0]
             try:
@@ -833,53 +856,113 @@ class BatchedDeviceNFA:
         self._pos_obs = None
         self._pend_accum = 0
 
-    def _pull_raw(self) -> Optional[Dict[str, np.ndarray]]:
-        """Pull pending matches + the node pools off the device and clear
-        the ring (a sync point). Decode happens separately (`_decode_raw`)
-        so callers can overlap the Python materialization with the next
-        dispatched batch. Returns None when nothing is pending.
+    def _drain_compact(self):
+        """The jitted drain-side compactor: project the pend chains into
+        pinned-rank space so the pull transfers only what decode reads.
 
-        Bucketed pulls: the compacted region only holds `node_count` live
-        nodes per key (post-GC ids are dense from 0), so the dominant D2H
-        transfer is sliced to the max live count, rounded up to a power of
-        two to bound the number of distinct sliced programs to O(log B)
-        (PERF.md round-3 lever 3: decode pull width).
+        The `pinned` bitmap IS the pend-reachable closure (the GC
+        maintains exactly that invariant), so compacting node data by
+        pinned rank yields the minimal self-consistent snapshot: pend ids
+        and predecessor pointers are value-remapped into the same rank
+        space. The full region pull this replaces moved pow2(max
+        node_count) rows x 3 arrays over a ~100 MB/s tunnel -- live-lane
+        chains included, which decode never looks at."""
+        if self._drain_compact_fn is None:
+
+            @jax.jit
+            def drain_compact(pool):
+                pinned = pool["pinned"]  # [B, K]
+                B = pinned.shape[0]
+                csum = jnp.cumsum(pinned.astype(jnp.int32), axis=0)
+                pcount = csum[-1]                          # [K]
+                remap = jnp.where(pinned, csum - 1, -1)    # [B, K]
+                remap_full = jnp.concatenate(
+                    [remap, jnp.full((1,) + remap.shape[1:], -1, jnp.int32)]
+                )
+
+                def remap_vals_1(r, ids):
+                    return jnp.where(ids >= 0, r[ids.clip(0)], -1)
+
+                remap_vals = jax.vmap(remap_vals_1, in_axes=-1, out_axes=-1)
+                prank = jnp.where(pinned, csum - 1, B)     # holes -> trash
+                kk = jnp.arange(pinned.shape[1])[None, :]
+
+                def compact_by(vals):
+                    out = jnp.full((B + 1,) + vals.shape[1:], -1, vals.dtype)
+                    return out.at[prank, kk].set(
+                        jnp.where(pinned, vals, -1)
+                    )[:B]
+
+                pend_r = remap_vals(remap_full, pool["pend"])
+                ev = compact_by(pool["node_event"])
+                nm = compact_by(pool["node_name"])
+                pr = compact_by(remap_vals(remap_full, pool["node_pred"]))
+                return pend_r, ev, nm, pr, pcount
+
+            self._drain_compact_fn = drain_compact
+        return self._drain_compact_fn
+
+    def _pull_raw(self) -> Optional[Dict[str, np.ndarray]]:
+        """Pull pending matches + their chain nodes off the device and
+        clear the ring (a sync point). Decode happens separately
+        (`_decode_raw`) so callers can overlap the Python materialization
+        with the next dispatched batch. Returns None when nothing is
+        pending.
+
+        Bucketed pulls: nodes are first compacted to pinned-rank space on
+        device (`_drain_compact` -- exactly the pend-reachable closure),
+        then sliced at pow2(max pinned count) so the D2H transfer tracks
+        pending-match volume, not region capacity, and the number of
+        distinct sliced programs stays O(log B). The pull rides a
+        ~100 MB/s tunnel with ~0.1-0.2 s per-transfer overhead, so both
+        bytes and transfer count are the cost (PERF.md).
         """
-        counts = np.asarray(self.pool["pend_count"])  # [K]
+        # One fused [3, K] probe: pending counts + pinned closure sizes +
+        # ring cursors (one tunnel round-trip for everything the drain's
+        # host logic needs).
+        if self._drain_counts_fn is None:
+            self._drain_counts_fn = jax.jit(
+                lambda p: jnp.stack(
+                    [p["pend_count"],
+                     jnp.sum(p["pinned"].astype(jnp.int32), axis=0),
+                     p["pend_pos"]]
+                )
+            )
+        both = np.asarray(self._drain_counts_fn(self.pool))
+        counts = both[0]
         self.last_match_counts = counts
         if counts.sum() == 0:
-            if int(np.asarray(self.pool["pend_pos"]).max()) > 0:
-                self.pool = self._drain_pend(self.pool)  # reclaim hole pages
+            if int(both[2].max()) > 0:
+                self.pool = self._drain_pend(self.pool)  # reclaim cursor
             self._ring_cleared()
             return None
-        max_nodes = int(np.asarray(self.pool["node_count"]).max())
         full_b = self.pool["node_event"].shape[0]
         full_m = self.pool["pend"].shape[0]
         Bb = 1
-        while Bb < max(max_nodes, 1):
+        while Bb < max(int(both[1].max()), 1):
             Bb <<= 1
         Bb = min(Bb, full_b)
-        # The paged ring is mostly holes (-1): compact valid ids to a
-        # per-key prefix on-device (one stable sort) so the D2H transfer
-        # is pow2(max per-key count) wide, not pend_pos wide -- the pull
-        # rides a ~100 MB/s tunnel, so bytes are the cost (PERF.md).
+        pend_r, ev, nm, pr, _ = self._drain_compact()(self.pool)
+        # The ring may still carry holes between keys' counts: compact
+        # valid ids to a per-key prefix so the pend pull is pow2(max
+        # count) wide.
         if self._compact_pend_fn is None:
+            from ..ops.engine import compact_valid_front
+
             self._compact_pend_fn = jax.jit(
-                lambda p: jnp.take_along_axis(
-                    p, jnp.argsort(p < 0, axis=0, stable=True), axis=0
-                )
+                lambda p: compact_valid_front(p)[0]
             )
-        compacted = self._compact_pend_fn(self.pool["pend"])
+        compacted = self._compact_pend_fn(pend_r)
         Mb = 1
         while Mb < max(int(counts.max()), 1):
             Mb <<= 1
         Mb = min(Mb, full_m)
         raw = {
             "counts": counts,
-            "pend": np.asarray(compacted[:Mb]).T,                    # [K, Mb]
-            "node_event": np.asarray(self.pool["node_event"][:Bb]).T,  # [K, Bb]
-            "node_name": np.asarray(self.pool["node_name"][:Bb]).T,
-            "node_pred": np.asarray(self.pool["node_pred"][:Bb]).T,
+            "pend": np.asarray(compacted[:Mb]).T,      # [K, Mb]
+            "node_event": np.asarray(ev[:Bb]).T,       # [K, Bb] pinned-rank
+            "node_name": np.asarray(nm[:Bb]).T,
+            "node_pred": np.asarray(pr[:Bb]).T,
         }
         self.pool = self._drain_pend(self.pool)
         self._ring_cleared()

@@ -85,20 +85,32 @@ def build_batched_advance(query: CompiledQuery, config: EngineConfig):
 
 
 def build_batched_post(query: CompiledQuery, config: EngineConfig):
-    """jit-compiled multi-key post pass: unvmapped pend-page append (the
-    page offset is uniform across keys, so vmapping it would only manufacture
-    a serialized per-key scatter) + the per-key GC vmapped over the trailing
-    key axis.
+    """jit-compiled multi-key post pass: unvmapped dense scatter-append
+    (the page scatters every key's real ids at its own count cursor in one
+    op) + the per-key GC vmapped over the trailing key axis + the ring
+    remap as a dynamic block loop over the occupied prefix
+    (engine.remap_pend_blocks -- the remap cost tracks true occupancy,
+    which only the device knows).
     """
-    from ..ops.engine import build_gc, build_pend_append
+    from ..ops.engine import build_gc, build_pend_append, remap_pend_blocks
 
     append = build_pend_append(config)
-    gc = jax.vmap(build_gc(query, config), in_axes=(-1, -1, -1, -1), out_axes=(-1, -1))
+    gc = jax.vmap(
+        build_gc(query, config, defer_pend_remap=True),
+        in_axes=(-1, -1, -1, -1), out_axes=(-1, -1, -1),
+    )
 
     @jax.jit
     def post(state, pool, ys):
         state, pool, page_roots = append(state, pool, ys["w_match"])
-        return gc(state, pool, ys, page_roots)
+        state, pool, remap_full = gc(state, pool, ys, page_roots)
+        pool = {
+            **pool,
+            "pend": remap_pend_blocks(
+                pool["pend"], remap_full, pool["pend_pos"]
+            ),
+        }
+        return state, pool
 
     return post
 

@@ -193,10 +193,10 @@ def test_auto_drain_preserves_matches_under_pend_pressure():
     )
     stages = compile_pattern(pattern)
     keys = ["k0", "k1"]
-    # One 24-slot page per matching 6-event batch; a 48-slot ring would
-    # overflow on the 3rd undrained batch.
+    # The dense append stores only real matches (2 per 6-event batch per
+    # key), so overflowing the 48-slot ring takes >24 matching batches.
     config = EngineConfig(lanes=8, nodes=256, matches=48, matches_per_step=4)
-    n_batches, T = 6, 6
+    n_batches, T = 30, 6
     streams = {k: [
         Event(k, "ABC"[i % 3], TS + i, "t", 0, i) for i in range(T * n_batches)
     ] for k in keys}
@@ -219,6 +219,58 @@ def test_auto_drain_preserves_matches_under_pend_pressure():
     out_off, drops_off = run(False)
     assert drops_off > 0  # the loud counter: overflow is visible, not silent
     assert sum(len(v) for v in out_off.values()) < 2 * expect
+
+
+def test_dense_append_defers_host_drains_on_sparse_matches():
+    """The scatter-append keeps ring occupancy equal to the TRUE match
+    count (no hole pages), so a sparse stream must run arbitrarily many
+    undrained batches through a small ring without the capacity guard
+    forcing a sync host drain -- and nothing may be lost or reordered."""
+    pattern = (
+        QueryBuilder()
+        .select("a").where(value() == "A")
+        .then().select("b").where(value() == "B")
+        .then().select("c").where(value() == "C")
+        .build()
+    )
+    stages = compile_pattern(pattern)
+    keys = ["k0", "k1"]
+    # Per-advance worst case = T * matches_per_step = 24 slots; a paged
+    # (hole-carrying) ring of 96 would force a drain every 4 undrained
+    # batches, but the dense append stores only the ~1 real match/batch.
+    config = EngineConfig(lanes=8, nodes=256, matches=96, matches_per_step=4)
+    # One ABC match + 3 noise events per 6-event batch.
+    n_batches, T = 10, 6
+    letters = "ABCDDD"
+    streams = {
+        k: [
+            Event(k, letters[i % 6], TS + i, "t", 0, i)
+            for i in range(T * n_batches)
+        ]
+        for k in keys
+    }
+
+    bat = BatchedDeviceNFA(stages, keys=keys, config=config)
+    pulls = 0
+    orig_pull = bat._pull_raw
+
+    def counting_pull():
+        nonlocal pulls
+        pulls += 1
+        return orig_pull()
+
+    bat._pull_raw = counting_pull
+    for b in range(n_batches):
+        bat.advance_packed(
+            bat.pack({k: s[b * T:(b + 1) * T] for k, s in streams.items()}),
+            decode=False,
+        )
+        # Let the async probes land so the guard sees true counts.
+        jax.block_until_ready(bat.state["n_events"])
+    assert pulls == 0  # no mid-run host drain: occupancy == true counts
+    out = bat.drain()
+    assert bat.stats["match_drops"] == 0
+    assert {k: len(v) for k, v in out.items()} == {k: n_batches for k in keys}
 
 
 def test_pallas_sharded_over_mesh():
