@@ -333,31 +333,38 @@ def bench_device_batched(
         engine=ARGS.engine,
     )
     rng = random.Random(7)
+    n_warm = 2  # warmup batches (compiles incl. a match-bearing drain)
     n_lat = 4   # extra batches for the per-batch latency pass
     n_e2e = max(n_batches - 1, 1)  # batches for the interleaved-ingest pass
-    total_b = n_batches + n_lat + n_e2e
+    total_b = n_warm + n_batches + n_lat + n_e2e
     streams = {k: stream_fn(rng, batch * total_b) for k in bat.keys}
 
     t_pack0 = time.perf_counter()
     packed = [
         bat.pack({k: s[b * batch: (b + 1) * batch] for k, s in streams.items()})
-        for b in range(n_batches)
+        for b in range(n_warm + n_batches)
     ]
     pack_s = time.perf_counter() - t_pack0
 
-    bat.advance_packed(packed[0], decode=True)  # warmup compiles advance+gc+drain
+    # Warmup: compile the advance/post programs AND the full drain/decode
+    # path at realistic bucket sizes -- a drain with real matches pending
+    # compiles the closure walk, the sliced pulls and the decoder; the
+    # empty-ring early return would leave those to the timed pass.
+    for xs in packed[:n_warm]:
+        bat.advance_packed(xs, decode=False)
+    bat.drain()
     jax.block_until_ready(bat.state["n_events"])
 
     # Throughput pass (engine-only): batches pre-packed, no per-batch sync,
     # one drain at the end.
     t0 = time.perf_counter()
-    for xs in packed[1:n_batches]:
+    for xs in packed[n_warm:]:
         bat.advance_packed(xs, decode=False)
     jax.block_until_ready(bat.state["n_events"])
     drained = bat.drain()
     n_matches = sum(len(v) for v in drained.values())
     dt = time.perf_counter() - t0
-    n = (n_batches - 1) * batch * n_keys
+    n = n_batches * batch * n_keys
 
     # End-to-end pass: pack + advance interleaved on one thread. Dispatch
     # is async, so packing batch b+1 overlaps the device computing batch b
@@ -366,7 +373,7 @@ def bench_device_batched(
     # synthetic stream generator is not part of the system under test.
     e2e_chunks = [
         {k: s[b * batch: (b + 1) * batch] for k, s in streams.items()}
-        for b in range(n_batches, n_batches + n_e2e)
+        for b in range(n_warm + n_batches, n_warm + n_batches + n_e2e)
     ]
     t0 = time.perf_counter()
     for chunk in e2e_chunks:
@@ -386,7 +393,7 @@ def bench_device_batched(
 
     lat_packed = [
         bat.pack({k: s[b * batch: (b + 1) * batch] for k, s in streams.items()})
-        for b in range(n_batches + n_e2e, total_b)
+        for b in range(n_warm + n_batches + n_e2e, total_b)
     ]
     bat.timings = BatchTimings()
     lat_ms: List[float] = []
@@ -405,7 +412,7 @@ def bench_device_batched(
         e2e_eps=e2e_n / e2e_dt, e2e_matches=e2e_matches,
         lat_matches=lat_matches,
         keys=n_keys, batch=batch, lanes=config.lanes, engine=bat.engine,
-        pack_eps=n_batches * batch * n_keys / pack_s,
+        pack_eps=(n_warm + n_batches) * batch * n_keys / pack_s,
         p50_batch_ms=float(np.percentile(lat_ms, 50)),
         p99_batch_ms=float(np.percentile(lat_ms, 99)),
         p50_match_emit_ms=lat_summary.get("emit_latency_ms_p50"),
@@ -431,19 +438,25 @@ def bench_device_latency(
         engine=ARGS.engine,
     )
     rng = random.Random(23)
-    streams = {k: stream_fn(rng, batch * (n_batches + 1)) for k in bat.keys}
+    n_warm = 3
+    streams = {
+        k: stream_fn(rng, batch * (n_batches + n_warm)) for k in bat.keys
+    }
     packed = [
         bat.pack({k: s[b * batch: (b + 1) * batch] for k, s in streams.items()})
-        for b in range(n_batches + 1)
+        for b in range(n_batches + n_warm)
     ]
     from kafkastreams_cep_tpu.ops.profiling import BatchTimings
 
-    bat.advance_packed(packed[0], decode=True)  # warmup
+    # Warmup across several batches: the first match-bearing drain is what
+    # compiles the pull/decode programs (an empty drain early-returns).
+    for xs in packed[:n_warm]:
+        bat.advance_packed(xs, decode=True)
     jax.block_until_ready(bat.state["n_events"])
     bat.timings = BatchTimings()
     t0 = time.perf_counter()
     n_matches = 0
-    for xs in packed[1:]:
+    for xs in packed[n_warm:]:
         out = bat.advance_packed(xs, decode=True)
         n_matches += sum(len(v) for v in out.values())
     dt = time.perf_counter() - t0
@@ -488,6 +501,7 @@ def bench_multi_query(
     config = EngineConfig(
         lanes=8 * n_queries, nodes=1024, matches=4096,
         matches_per_step=4 * n_queries, nodes_per_step=8 * n_queries,
+        pin_interval=True,
     )
     eng = StackedQueryEngine(
         [(f"q{i}", query_pattern(i)) for i in range(n_queries)],
@@ -575,9 +589,14 @@ def main() -> None:
             # prefix-bucketed remap keeps the big ring nearly free.
             # nodes=2048: deferring every drain to pass-end pins the whole
             # pass's match chains in the region at once.
-            EngineConfig(lanes=256, nodes=2048, matches=16384,
-                         matches_per_step=32, nodes_per_step=32,
-                         strict_windows=True),
+            # pin_interval: sparse-match workload (puts/key/interval <<
+            # nodes), so the ID-interval pin replaces the GC page walks.
+            # Sized ZERO-drop across 21 continuous batches incl. rare
+            # population peaks (lanes 288, per-step caps 64, nodes=3072
+            # for interval retention + live chains at peaks).
+            EngineConfig(lanes=288, nodes=3072, matches=16384,
+                         matches_per_step=64, nodes_per_step=64,
+                         strict_windows=True, pin_interval=True),
             n_keys, bb, nb,
         )
         detail["skip_any8_batched"] = batched
@@ -585,7 +604,8 @@ def main() -> None:
         hc = bench_device_batched(
             letters_pattern, None, letters_stream,
             EngineConfig(lanes=8, nodes=1024, matches=2048,
-                         matches_per_step=4, nodes_per_step=8),
+                         matches_per_step=4, nodes_per_step=8,
+                         pin_interval=True),
             (ARGS.keys or (8 if quick else 4096)), bb, nb,
         )
         detail["highcard_letters_batched"] = hc
@@ -617,9 +637,9 @@ def main() -> None:
         lat_nb = 4 if quick else 24
         lat = bench_device_latency(
             skip_any8_pattern, None, skip_any8_stream,
-            EngineConfig(lanes=256, nodes=1024, matches=1024,
-                         matches_per_step=32, nodes_per_step=32,
-                         strict_windows=True),
+            EngineConfig(lanes=288, nodes=2048, matches=2048,
+                         matches_per_step=64, nodes_per_step=64,
+                         strict_windows=True, pin_interval=True),
             lat_keys, lat_T, lat_nb,
         )
         detail["skip_any8_latency"] = lat

@@ -74,6 +74,8 @@ from .tables import (
 )
 
 _I32_MAX = np.int64(2**31 - 1)
+#: `pend_min` sentinel: no pending match (any real node id is smaller).
+_PEND_MIN_NONE = np.int32(2**31 - 1)
 
 
 @dataclass(frozen=True)
@@ -100,6 +102,19 @@ class EngineConfig:
     #: event (ts >= 0) expires -- the bounded-memory mode (matches the host
     #: oracle's NFA(strict_windows=True)).
     strict_windows: bool = False
+    #: Pin pending matches' chains by ID INTERVAL instead of per-chain
+    #: frontier walks. The GC's stable sweep keeps node ids
+    #: creation-ordered, and a chain's root is its oldest node, so
+    #: everything a pending match can reference lies in
+    #: [min pending chain-root id, end) -- one compare replaces the
+    #: page-root walks (the dominant post-pass term at production shapes,
+    #: PERF.md v7). The trade: ALL nodes younger than the oldest pending
+    #: root stay resident until a drain, so this suits sparse-match
+    #: workloads (puts-per-drain-interval << nodes); put-heavy queries
+    #: (e.g. one_or_more matching most events) should keep the default
+    #: precise walks or size `nodes` for the interval's put volume.
+    #: node_drops stays the loud overflow signal either way.
+    pin_interval: bool = False
 
     def dewey_width(self, query: CompiledQuery) -> int:
         return self.digits if self.digits > 0 else query.n_stages + 2
@@ -136,6 +151,11 @@ def init_state(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndar
         "vlen": np.zeros(R, np.int32),         # digit count
         "seq": np.zeros(R, np.int32),          # run id (NFA.java runs counter)
         "node": np.full(R, -1, np.int32),      # last matched event's buffer node
+        "root": np.full(R, -1, np.int32),      # FIRST node of the run's chain
+        #                                        (invariant: root >= 0 iff
+        #                                        node >= 0; chains share roots
+        #                                        across branch clones; feeds
+        #                                        interval pinning's pend_min)
         "ts": np.full(R, -1, np.int32),        # start timestamp (rebased ms)
         "branching": np.zeros(R, bool),
         "ignored": np.zeros(R, bool),
@@ -187,6 +207,9 @@ def init_pool(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndarr
         "pend_count": jnp.asarray(0, jnp.int32),
         "pend_pos": jnp.asarray(0, jnp.int32),
         "pinned": jnp.zeros(B, bool),
+        #: min chain-root id over pending matches (interval pinning's
+        #: lower bound; _PEND_MIN_NONE when nothing is pending).
+        "pend_min": jnp.asarray(_PEND_MIN_NONE, jnp.int32),
     }
 
 
@@ -323,6 +346,7 @@ def build_step(
         src = state["src"]
         eps = state["eps"]
         lane_node = state["node"]
+        lane_root = state["root"]
         lane_ts = state["ts"]
         lane_seq = state["seq"]
         regs_in = state["regs"]
@@ -667,6 +691,11 @@ def build_step(
         o_vlen = jnp.stack(slot_vlen, axis=1)
         o_seq = jnp.stack(slot_seq, axis=1)
         o_node = jnp.stack(slot_node, axis=1)
+        # Chain root: a lane with a chain passes its root to every slot
+        # (any fresh put extends that chain); a chainless lane's slot
+        # chain starts at the slot's own node (-1 when none) -- the
+        # root >= 0 iff node >= 0 invariant makes this a single select.
+        o_root = jnp.where(lane_root[:, None] >= 0, lane_root[:, None], o_node)
         o_ts = jnp.stack(slot_ts, axis=1)
         o_br = jnp.stack(slot_br, axis=1)
         o_ig = jnp.stack(slot_ig, axis=1)
@@ -696,6 +725,7 @@ def build_step(
 
         msel, mok = _nth_set_select(is_match, M_STEP)
         w_match = jnp.where(mok, o_node.reshape(-1)[msel], -1)
+        w_mroot = jnp.where(mok, o_root.reshape(-1)[msel], -1)
         step_match_drops = jnp.maximum(n_match - M_STEP, 0)
 
         sel, lane_ok = _nth_set_select(keep_2d, R)
@@ -713,6 +743,7 @@ def build_step(
         n_vlen = compact(o_vlen, 0)
         n_seq = compact(o_seq, 0)
         n_node = compact(o_node, -1)
+        n_root = compact(o_root, -1)
         n_ts = compact(o_ts, -1)
         n_br = compact(o_br, False)
         n_ig = compact(o_ig, False)
@@ -721,7 +752,8 @@ def build_step(
 
         new_state = {
             "active": n_active, "src": n_src, "eps": n_eps, "ver": n_ver,
-            "vlen": n_vlen, "seq": n_seq, "node": n_node, "ts": n_ts,
+            "vlen": n_vlen, "seq": n_seq, "node": n_node, "root": n_root,
+            "ts": n_ts,
             "branching": n_br, "ignored": n_ig,
             "regs": n_regs, "regs_set": n_regs_set,
             "runs": new_runs,
@@ -746,6 +778,7 @@ def build_step(
             "w_name": jnp.where(valid, w_name, -1),
             "w_pred": jnp.where(valid, w_pred, -1),
             "w_match": jnp.where(valid, w_match, -1),
+            "w_mroot": jnp.where(valid, w_mroot, -1),
         }
         if debug:
             dbg = dict(
@@ -781,10 +814,26 @@ def build_pend_append(config: EngineConfig):
     M = config.matches
     M_STEP = config.matches_per_step
 
+    def _min_root(
+        pool: Dict[str, jnp.ndarray],
+        roots: jnp.ndarray,
+        placed_m: jnp.ndarray,
+    ) -> jnp.ndarray:
+        """min(pend_min, min chain-root id over PLACED matches): interval
+        pinning's lower bound (dropped matches are lost+counted, so they
+        must not pin; chainless matches carry root -1 and pin nothing)."""
+        cand = jnp.where(
+            placed_m & (roots >= 0), roots, _PEND_MIN_NONE
+        )
+        return jnp.minimum(pool["pend_min"], jnp.min(cand, axis=0)).astype(
+            jnp.int32
+        )
+
     def append_compact(
         state: Dict[str, jnp.ndarray],
         pool: Dict[str, jnp.ndarray],
         ids: jnp.ndarray,  # [TM] or [TM, K]
+        roots: jnp.ndarray,
     ):
         """Fallback when a page exceeds the ring (TM > M): sort the page's
         valid ids to the front and place them at each key's own `pend_pos`
@@ -810,33 +859,37 @@ def build_pend_append(config: EngineConfig):
         new_pend = jnp.where(take, gathered, pool["pend"])
         placed = jnp.minimum(jnp.maximum(M - pos, 0), n_m)
         drops = n_m - placed
+        placed_m = m_valid & (pos + rank < M)
         new_pool = {
             **pool,
             "pend": new_pend,
             "pend_count": pool["pend_count"] + placed,
             "pend_pos": (pos + placed).astype(jnp.int32),
+            "pend_min": _min_root(pool, roots, placed_m),
         }
         new_state = {
             **state,
             "match_drops": state["match_drops"] + drops,
         }
-        page_roots = jnp.where(m_valid & (pos + rank < M), ids, -1)
+        page_roots = jnp.where(placed_m, ids, -1)
         return new_state, new_pool, page_roots
 
     def append(
         state: Dict[str, jnp.ndarray],
         pool: Dict[str, jnp.ndarray],
         w_match: jnp.ndarray,  # [T, M_STEP] or [T, M_STEP, K]
+        w_mroot: jnp.ndarray,  # same shape: each match's chain-root id
     ):
         T = w_match.shape[0]
         TM = T * M_STEP
         rest = w_match.shape[2:]
         ids = w_match.reshape((TM,) + rest)
+        roots = w_mroot.reshape((TM,) + rest)
         if TM > M or not rest:
             # Oversized pages can't ride the scatter (every slot may be
             # real); and the single-key pool ([M], no key axis) is trivial
             # at the compact path's O(M) arithmetic.
-            return append_compact(state, pool, ids)
+            return append_compact(state, pool, ids, roots)
         pend = pool["pend"]
         pos = pool["pend_pos"]  # [K] per-key TRUE counts (no holes)
         # Dense scatter-append: each key's valid ids land at its own
@@ -866,6 +919,7 @@ def build_pend_append(config: EngineConfig):
             "pend": new_pend,
             "pend_count": pool["pend_count"] + placed,
             "pend_pos": (pos + placed).astype(jnp.int32),
+            "pend_min": _min_root(pool, roots, placed_m),
         }
         new_state = {
             **state,
@@ -966,21 +1020,41 @@ def build_gc(
             marked, _ = jax.lax.while_loop(cond, body, (marked, frontier))
             return marked
 
-        # Phase 1: the pend-reachable closure = old pins (already a closed
-        # set: preds of pinned nodes are pinned) + this advance's match
-        # page. This closure -- and ONLY this closure -- becomes the new
-        # `pinned` bitmap, so match-free streams keep pinned empty.
-        TM_page = page_roots.shape[0]
-        m_step = max(config.matches_per_step, 1)
-        if TM_page % m_step == 0 and TM_page > m_step:
-            # [T * M_STEP] t-major -> slot-major (valid-dense prefix).
-            page_sm = page_roots.reshape(-1, m_step).T.reshape(TM_page)
+        if config.pin_interval:
+            # Interval pinning: the stable sweep below keeps ids
+            # creation-ordered, a chain's root is its oldest (smallest)
+            # node, and `pend_min` is the min root over pending matches --
+            # so the whole pend-reachable set lies in [pend_min, BW) and
+            # ONE compare replaces the chunked page-root walks (the
+            # dominant post-pass term, PERF.md v7). Conservative: every
+            # node younger than the oldest pending root stays resident
+            # until a drain (see EngineConfig.pin_interval for the
+            # trade). The previous interval is covered automatically:
+            # pend_min only decreases between drains and both sides
+            # remap consistently each sweep.
+            node_valid = jnp.concatenate(
+                [pool["node_event"] >= 0, w_event >= 0, jnp.zeros(1, bool)]
+            )
+            marked_pin = (
+                jnp.arange(BW + 1) >= pool["pend_min"]
+            ) & node_valid
         else:
-            page_sm = page_roots
-        CHUNK = 256  # all-hole chunks exit their while_loop after one reduce
-        marked_pin = marked0
-        for c0 in range(0, TM_page, CHUNK):
-            marked_pin = walk(marked_pin, page_sm[c0 : c0 + CHUNK])
+            # Phase 1: the pend-reachable closure = old pins (already a
+            # closed set: preds of pinned nodes are pinned) + this
+            # advance's match page. This closure -- and ONLY this closure
+            # -- becomes the new `pinned` bitmap, so match-free streams
+            # keep pinned empty.
+            TM_page = page_roots.shape[0]
+            m_step = max(config.matches_per_step, 1)
+            if TM_page % m_step == 0 and TM_page > m_step:
+                # [T * M_STEP] t-major -> slot-major (valid-dense prefix).
+                page_sm = page_roots.reshape(-1, m_step).T.reshape(TM_page)
+            else:
+                page_sm = page_roots
+            CHUNK = 256  # all-hole chunks exit the while_loop in one reduce
+            marked_pin = marked0
+            for c0 in range(0, TM_page, CHUNK):
+                marked_pin = walk(marked_pin, page_sm[c0 : c0 + CHUNK])
         # Phase 2: + live-lane chains (kept this GC, but NOT pinned -- if
         # the lane survives, the next GC re-marks them from the lane root).
         marked = walk(marked_pin, lane_roots)
@@ -1006,6 +1080,17 @@ def build_gc(
             new_pend = pend  # rewritten by remap_pend_blocks in the wrapper
         else:
             new_pend = jnp.where(pend >= 0, remap_full[pend.clip(0)], -1)
+        # pend_min rides the same remap (its node is pend-reachable, hence
+        # marked). A dropped root (rank >= B under region overflow, itself
+        # counted in node_drops) degrades to 0 = pin-everything, never to
+        # an unpinning sentinel.
+        pm = pool["pend_min"]
+        pm_remap = remap_full[jnp.clip(pm, 0, BW)]
+        new_pend_min = jnp.where(
+            pm == _PEND_MIN_NONE,
+            _PEND_MIN_NONE,
+            jnp.maximum(pm_remap, 0),
+        ).astype(jnp.int32)
         new_pool = {
             "node_event": jnp.where(ok, combined_event[sel], -1),
             "node_name": jnp.where(ok, combined_name[sel], -1),
@@ -1015,11 +1100,15 @@ def build_gc(
             "pend_count": pool["pend_count"],
             "pend_pos": pool["pend_pos"],
             "pinned": marked_pin[sel] & ok,
+            "pend_min": new_pend_min,
         }
         new_state = {
             **state,
             "node": jnp.where(
                 state["node"] >= 0, remap_full[state["node"].clip(0)], -1
+            ).astype(jnp.int32),
+            "root": jnp.where(
+                state["root"] >= 0, remap_full[state["root"].clip(0)], -1
             ).astype(jnp.int32),
             "node_drops": state["node_drops"]
             + jnp.maximum(n_keep - B, 0).astype(jnp.int32),
@@ -1083,7 +1172,9 @@ def build_post(query: CompiledQuery, config: EngineConfig):
         pool: Dict[str, jnp.ndarray],
         ys: Dict[str, jnp.ndarray],
     ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
-        state, pool, page_roots = append(state, pool, ys["w_match"])
+        state, pool, page_roots = append(
+            state, pool, ys["w_match"], ys["w_mroot"]
+        )
         return gc(state, pool, ys, page_roots)
 
     return post
@@ -1127,6 +1218,7 @@ def drain_pend(pool: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
         "pend_count": jnp.zeros_like(pool["pend_count"]),
         "pend_pos": jnp.zeros_like(pool["pend_pos"]),
         "pinned": jnp.zeros_like(pool["pinned"]),
+        "pend_min": jnp.full_like(pool["pend_min"], _PEND_MIN_NONE),
     }
 
 

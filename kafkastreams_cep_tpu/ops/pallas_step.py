@@ -69,7 +69,8 @@ HI = jax.lax.Precision.HIGHEST
 
 #: per-lane i32 state fields, in the stacked-lanes array order.
 LANE_FIELDS = (
-    "active", "src", "eps", "vlen", "seq", "node", "ts", "branching", "ignored",
+    "active", "src", "eps", "vlen", "seq", "node", "ts", "branching",
+    "ignored", "root",
 )
 #: per-key scalar counters, in the stacked-counters array order.
 COUNTER_FIELDS = (
@@ -355,6 +356,7 @@ def build_pallas_batched_advance(
     def kernel(
         xi_ref, xf_ref, lanes_ref, ver_ref, regs_ref, rset_ref, ctr_ref,
         lanes_o, ver_o, regs_o, rset_o, ctr_o, wev_o, wnm_o, wpr_o, wmt_o,
+        wmr_o,
     ):
         t = pl.program_id(1)
         masks_for, lut_i, lut_b = make_luts()
@@ -390,6 +392,7 @@ def build_pallas_batched_advance(
         src = st["src"]
         eps = st["eps"]
         lane_node = st["node"]
+        lane_root = st["root"]
         lane_ts = st["ts"]
         lane_seq = st["seq"]
         runs = ctr[:, 0:1]
@@ -721,6 +724,13 @@ def build_pallas_batched_advance(
                 )
             )
 
+        # Chain root per slot: a lane with a chain passes its root to every
+        # slot; a chainless lane's slot chain starts at the slot's own node
+        # (engine.py o_root -- the root >= 0 iff node >= 0 invariant).
+        has_root = lane_root >= 0
+        for s in slots:
+            s["root"] = jnp.where(has_root, lane_root, s["node"])
+
         # ==== fresh run ids in (lane, slot) DFS order (engine.py:636-643) ===
         ns_masks = [s["occ"] & s["newseq"] for s in slots]
         ns_cnt = jnp.zeros((8, R), jnp.int32)
@@ -754,14 +764,25 @@ def build_pallas_batched_advance(
 
         msel = select_slots(
             match_masks, m_ranks,
-            [(lambda s=s: [(s["node"] + 1).astype(jnp.float32)]) for s in slots],
+            [
+                (
+                    lambda s=s: [
+                        (s["node"] + 1).astype(jnp.float32),
+                        (s["root"] + 1).astype(jnp.float32),
+                    ]
+                )
+                for s in slots
+            ],
             M_STEP,
-            1,
+            2,
         )
         mj = jax.lax.broadcasted_iota(jnp.int32, (8, M_STEP), 1)
         mok = mj < jnp.minimum(n_match, M_STEP)
         w_match = jnp.where(
             mok & valid, msel[:, 0, :].astype(jnp.int32) - 1, -1
+        )
+        w_mroot = jnp.where(
+            mok & valid, msel[:, 1, :].astype(jnp.int32) - 1, -1
         )
         step_match_drops = jnp.maximum(n_match - M_STEP, 0)
         lane_drop_count = jnp.maximum(n_keep - R, 0)
@@ -773,20 +794,21 @@ def build_pallas_batched_advance(
             seq_lo, seq_hi = _split16(s["seq"], 0)
             ts_lo, ts_hi = _split16(s["ts"], 1)
             nd_lo, nd_hi = _split16(s["node"], 1)
+            rt_lo, rt_hi = _split16(s["root"], 1)
             out = [
                 s["src"].astype(jnp.float32),
                 (s["eps"] + 1).astype(jnp.float32),
                 s["vlen"].astype(jnp.float32),
                 s["br"].astype(jnp.float32),
                 s["ig"].astype(jnp.float32),
-                seq_lo, seq_hi, ts_lo, ts_hi, nd_lo, nd_hi,
+                seq_lo, seq_hi, ts_lo, ts_hi, nd_lo, nd_hi, rt_lo, rt_hi,
             ]
             out.extend(s["ver"][d].astype(jnp.float32) for d in range(D))
             out.extend(s["regs"])
             out.extend(s["regs_set"][a].astype(jnp.float32) for a in range(A))
             return out
 
-        F_FIX = 11
+        F_FIX = 13
         ksel = select_slots(
             keep_masks, k_ranks,
             [(lambda s=s: slot_fields(s)) for s in slots],
@@ -807,6 +829,7 @@ def build_pallas_batched_advance(
         n_seq = jnp.where(lane_ok, _join16(ksel[:, 5, :], ksel[:, 6, :], 0), 0)
         n_ts = jnp.where(lane_ok, _join16(ksel[:, 7, :], ksel[:, 8, :], 1), -1)
         n_node = jnp.where(lane_ok, _join16(ksel[:, 9, :], ksel[:, 10, :], 1), -1)
+        n_root = jnp.where(lane_ok, _join16(ksel[:, 11, :], ksel[:, 12, :], 1), -1)
         n_ver = [
             jnp.where(lane_ok, ksel[:, F_FIX + d, :].astype(jnp.int32), 0)
             for d in range(D)
@@ -850,6 +873,7 @@ def build_pallas_batched_advance(
             "ts": jnp.where(vm, n_ts, lane_ts),
             "branching": jnp.where(vm, n_br, st["branching"]),
             "ignored": jnp.where(vm, n_ig, st["ignored"]),
+            "root": jnp.where(vm, n_root, lane_root),
         }
         for i, name in enumerate(LANE_FIELDS):
             lanes_o[i] = new_lanes[name].astype(jnp.int32)
@@ -863,6 +887,7 @@ def build_pallas_batched_advance(
         wnm_o[0] = w_name
         wpr_o[0] = w_pred
         wmt_o[0] = w_match
+        wmr_o[0] = w_mroot
 
     def advance_impl(state, xs):
         T, K = xs["valid"].shape
@@ -925,6 +950,7 @@ def build_pallas_batched_advance(
                 pl.BlockSpec((1, 8, P_CAP), lambda kb, t: (t, kb, 0)),
                 pl.BlockSpec((1, 8, P_CAP), lambda kb, t: (t, kb, 0)),
                 pl.BlockSpec((1, 8, M_STEP), lambda kb, t: (t, kb, 0)),
+                pl.BlockSpec((1, 8, M_STEP), lambda kb, t: (t, kb, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((NF, K, R), jnp.int32),
@@ -936,6 +962,7 @@ def build_pallas_batched_advance(
                 jax.ShapeDtypeStruct((T, K, P_CAP), jnp.int32),
                 jax.ShapeDtypeStruct((T, K, P_CAP), jnp.int32),
                 jax.ShapeDtypeStruct((T, K, M_STEP), jnp.int32),
+                jax.ShapeDtypeStruct((T, K, M_STEP), jnp.int32),
             ],
             compiler_params=pltpu.CompilerParams(
                 # Large (lanes, slots, caps) configs need more than the
@@ -945,7 +972,7 @@ def build_pallas_batched_advance(
             ),
             interpret=interpret,
         )(xi, xf, lanes, ver, regs, rset, ctr)
-        lanes_o, ver_o, regs_o, rset_o, ctr_o, wev, wnm, wpr, wmt = outs
+        lanes_o, ver_o, regs_o, rset_o, ctr_o, wev, wnm, wpr, wmt, wmr = outs
 
         new_state = dict(state)
         for i, name in enumerate(LANE_FIELDS):
@@ -958,7 +985,10 @@ def build_pallas_batched_advance(
         new_state["regs_set"] = jnp.transpose(rset_o, (2, 0, 1)).astype(bool)
         for i, c in enumerate(COUNTER_FIELDS):
             new_state[c] = ctr_o[:, i].astype(jnp.int32)
-        ys = {"w_event": wev, "w_name": wnm, "w_pred": wpr, "w_match": wmt}
+        ys = {
+            "w_event": wev, "w_name": wnm, "w_pred": wpr, "w_match": wmt,
+            "w_mroot": wmr,
+        }
         return new_state, ys
 
     if mesh is None:
@@ -974,7 +1004,7 @@ def build_pallas_batched_advance(
         xs_spec = jax.tree.map(lambda l: _key_axis_spec(l, 1), xs)
         ys_spec = {
             k: _key_axis_spec(jnp.zeros((1, 1, 1)), 1)
-            for k in ("w_event", "w_name", "w_pred", "w_match")
+            for k in ("w_event", "w_name", "w_pred", "w_match", "w_mroot")
         }
         return shard_map(
             advance_impl,
@@ -1011,7 +1041,10 @@ def build_pallas_batched_post(
         # w_match arrives [T, K, M_STEP]; the append wants the key axis
         # last ([T, M_STEP, K]) so its page reshape stays t-major.
         state, pool, page_roots = append(
-            state, pool, jnp.transpose(ys["w_match"], (0, 2, 1))
+            state,
+            pool,
+            jnp.transpose(ys["w_match"], (0, 2, 1)),
+            jnp.transpose(ys["w_mroot"], (0, 2, 1)),
         )
         state, pool, remap_full = gc(state, pool, ys, page_roots)
         pool = {

@@ -205,6 +205,8 @@ def oracle_to_device(
     # Fresh empty ring: the replay interval's matches were just returned by
     # the oracle, and the drivers only resync at drain boundaries (ring
     # drained). Pins start empty -- nothing is pending.
+    from .engine import _PEND_MIN_NONE
+
     pool = {
         "node_event": node_event,
         "node_name": node_name,
@@ -214,6 +216,7 @@ def oracle_to_device(
         "pend_count": np.asarray(0, np.int32),
         "pend_pos": np.asarray(0, np.int32),
         "pinned": np.zeros(B, bool),
+        "pend_min": np.asarray(_PEND_MIN_NONE, np.int32),
     }
 
     # -- lane table --------------------------------------------------------
@@ -270,6 +273,13 @@ def oracle_to_device(
             if val is not None:
                 state["regs"][i, slot] = np.float32(val)
                 state["regs_set"][i, slot] = True
+
+    # Per-lane chain roots: follow the freshly built predecessor pointers
+    # (the dense renumbering is creation-ordered, preserving the interval-
+    # pinning invariant that a chain's root is its smallest id).
+    from ..state.serde import _chain_roots
+
+    state["root"] = _chain_roots(state["node"], node_pred)
 
     # Observability counters carry through from the device state.
     for ctr in (

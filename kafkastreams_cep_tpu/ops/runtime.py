@@ -379,15 +379,16 @@ class DeviceNFA:
             decode_array_tree,
             decode_event_registry,
             read_magic,
-            upgrade_pool_tree,
+            upgrade_checkpoint_trees,
         )
 
         dev = cls(stages_or_query, schema=schema, config=config)
         r = _Reader(data)
         read_magic(r)
         tree = decode_array_tree(r.blob())
+        pool_tree = decode_array_tree(r.blob())
+        upgrade_checkpoint_trees(tree, pool_tree)
         dev.state = {k: jnp.asarray(v) for k, v in tree.items()}
-        pool_tree = upgrade_pool_tree(decode_array_tree(r.blob()))
         dev.pool = {k: jnp.asarray(v) for k, v in pool_tree.items()}
         dev._events = decode_event_registry(r.blob())
         dev._next_gidx = r.i64()

@@ -145,8 +145,8 @@ class BatchedDeviceNFA:
         #: match count: sparse streams never force a no-op sync drain,
         #: and a drain fires only when real match volume nears the ring.
         self._pos_probes: deque = deque()
-        #: (accum_at_obs, pos) from the freshest completed probe.
-        self._pos_obs: Optional[Tuple[int, int]] = None
+        #: (accum_at_obs, pos, region_fill) from the freshest probe.
+        self._pos_obs: Optional[Tuple[int, int, int]] = None
         self._drain_epoch = 0
         self._pos_max_fn = None
         self._drain_compact_fn = None
@@ -450,14 +450,25 @@ class BatchedDeviceNFA:
         # match_drops (loud) -- size EngineConfig.matches to at least one
         # page (T * matches_per_step) for loss-free deferred decode.
         if self.auto_drain and step_cap <= self.config.matches:
-            if self._occupancy_bound() + step_cap > self.config.matches:
+            occ, fill = self._occupancy_bound()
+            if (
+                occ + step_cap > self.config.matches
+                # Region pressure only matters when a drain can reclaim
+                # something: with nothing pending (occ == 0) the fill is
+                # live-lane chains that survive any drain, and firing on
+                # it would put a no-op sync on every advance.
+                or (occ > 0 and fill > (3 * self.config.nodes) // 4)
+            ):
                 # Real matches approach the ring size (the dense append
-                # keeps occupancy == true count): pull them off the
-                # device and clear the ring NOW, but decode them
-                # host-side only after the next advance is dispatched --
-                # the materialization then overlaps device compute.
-                # Applies to decoding advances too: their own drain only
-                # runs after the advance appended to the ring.
+                # keeps occupancy == true count), or undrained pins are
+                # squeezing the node region (3/4-full heuristic; interval
+                # pinning retains everything younger than the oldest
+                # pending root, so a drain is what un-pins): pull pending
+                # matches off the device and clear the ring NOW, but
+                # decode them host-side only after the next advance is
+                # dispatched -- the materialization then overlaps device
+                # compute. Applies to decoding advances too: their own
+                # drain only runs after the advance appended to the ring.
                 raw = self._pull_raw()
                 self._pend_accum = 0
         if self._pack_hwms:
@@ -744,7 +755,7 @@ class BatchedDeviceNFA:
             decode_array_tree,
             decode_event_registry,
             read_magic,
-            upgrade_pool_tree,
+            upgrade_checkpoint_trees,
         )
 
         r = _Reader(data)
@@ -755,8 +766,9 @@ class BatchedDeviceNFA:
             mesh=mesh, engine=engine,
         )
         tree = decode_array_tree(r.blob())
+        pool_tree = decode_array_tree(r.blob())
+        upgrade_checkpoint_trees(tree, pool_tree)
         state = {k: jnp.asarray(v) for k, v in tree.items()}
-        pool_tree = upgrade_pool_tree(decode_array_tree(r.blob()))
         pool = {k: jnp.asarray(v) for k, v in pool_tree.items()}
         if mesh is not None:
             state = shard_state(state, mesh)
@@ -819,22 +831,31 @@ class BatchedDeviceNFA:
         return mod
 
     def _dispatch_pos_probe(self) -> None:
-        """Start an async device->host copy of the ring cursor maximum."""
+        """Start an async device->host copy of [max ring cursor, max
+        region fill]: the first feeds the ring-capacity guard, the second
+        the region-pressure heuristic (undrained pins -- interval-pinned
+        retention especially -- squeeze the node region; a drain resets
+        pend_min so the next GC collects)."""
         if self._pos_max_fn is None:
-            self._pos_max_fn = jax.jit(lambda p: jnp.max(p))
-        arr = self._pos_max_fn(self.pool["pend_pos"])
+            self._pos_max_fn = jax.jit(
+                lambda pos, nc: jnp.stack([jnp.max(pos), jnp.max(nc)])
+            )
+        arr = self._pos_max_fn(self.pool["pend_pos"], self.pool["node_count"])
         try:
             arr.copy_to_host_async()
         except Exception:
             pass  # probe still resolves at is_ready()/int() time
         self._pos_probes.append((self._drain_epoch, self._pend_accum, arr))
 
-    def _occupancy_bound(self) -> int:
-        """Worst-case ring occupancy: the freshest completed cursor probe
-        plus the per-advance caps since it (falls back to the pure
-        worst-case accumulator while no probe has landed). Occupancy grows
-        by at most `step_cap` per advance, so adding the caps-since keeps
-        this an upper bound."""
+    def _occupancy_bound(self) -> Tuple[int, int]:
+        """(worst-case ring occupancy, freshest observed region fill).
+
+        Occupancy = the freshest completed cursor probe plus the
+        per-advance caps since it (falls back to the pure worst-case
+        accumulator while no probe has landed); it grows by at most
+        `step_cap` per advance, so adding the caps-since keeps it an
+        upper bound. The region fill is the raw observation (a pressure
+        heuristic, not a bound -- node_drops stays the loud backstop)."""
         while self._pos_probes:
             epoch, acc, arr = self._pos_probes[0]
             try:
@@ -844,11 +865,12 @@ class BatchedDeviceNFA:
                 break  # runtime without is_ready(): keep worst-case bound
             self._pos_probes.popleft()
             if epoch == self._drain_epoch:
-                self._pos_obs = (acc, int(arr))
+                vals = np.asarray(arr)
+                self._pos_obs = (acc, int(vals[0]), int(vals[1]))
         if self._pos_obs is not None:
-            acc, pos = self._pos_obs
-            return pos + (self._pend_accum - acc)
-        return self._pend_accum
+            acc, pos, fill = self._pos_obs
+            return pos + (self._pend_accum - acc), fill
+        return self._pend_accum, 0
 
     def _ring_cleared(self) -> None:
         """The pend ring was just drained: invalidate in-flight probes."""
@@ -857,27 +879,71 @@ class BatchedDeviceNFA:
         self._pend_accum = 0
 
     def _drain_compact(self):
-        """The jitted drain-side compactor: project the pend chains into
-        pinned-rank space so the pull transfers only what decode reads.
+        """The jitted drain-side compactor: walk the PRECISE pend-reachable
+        closure once, then project the pend chains into closure-rank space
+        so the pull transfers only what decode reads.
 
-        The `pinned` bitmap IS the pend-reachable closure (the GC
-        maintains exactly that invariant), so compacting node data by
-        pinned rank yields the minimal self-consistent snapshot: pend ids
-        and predecessor pointers are value-remapped into the same rank
-        space. The full region pull this replaces moved pow2(max
-        node_count) rows x 3 arrays over a ~100 MB/s tunnel -- live-lane
-        chains included, which decode never looks at."""
+        Under interval pinning the pool's `pinned` bitmap deliberately
+        over-approximates (every node younger than the oldest pending
+        root), which is the right trade per-advance but would inflate the
+        drain pull back to region width. The tunnel moves ~10 MB/s with
+        ~0.1-0.2 s per transfer, so the drain re-derives the exact
+        closure -- a chunked frontier walk over the ring's occupied
+        prefix, paid once per drain interval instead of once per advance
+        -- and compacts node data to its rank space: the pull then covers
+        pow2(max chains size) rows, and one stacked [3, Bb, K] leaf plus
+        one [3, K] counts leaf keep the transfer count at three total
+        (counts, nodes, pend)."""
         if self._drain_compact_fn is None:
 
             @jax.jit
             def drain_compact(pool):
-                pinned = pool["pinned"]  # [B, K]
-                B = pinned.shape[0]
+                pred = pool["node_pred"]  # [B, K]
+                pend = pool["pend"]
+                B, K = pred.shape
+                M = pend.shape[0]
+                kk = jnp.arange(K)[None, :]
+                CH = min(256, M)
+
+                def walk_chunk(carry):
+                    i, mk = carry
+                    off = jnp.minimum(i * CH, M - CH)
+                    fresh = (off + jnp.arange(CH) >= i * CH)[:, None]
+                    fr = jnp.where(
+                        fresh,
+                        jax.lax.dynamic_slice(pend, (off, 0), (CH, K)),
+                        -1,
+                    )
+
+                    def wcond(w):
+                        return jnp.any(w[1] >= 0)
+
+                    def wbody(w):
+                        m, f = w
+                        live = f >= 0
+                        cidx = jnp.where(live, f, B)
+                        already = jnp.take_along_axis(m, cidx, axis=0) & live
+                        m = m.at[cidx, kk].set(True)
+                        nxt = jnp.take_along_axis(
+                            pred, jnp.clip(cidx, 0, B - 1), axis=0
+                        )
+                        return m, jnp.where(live & ~already, nxt, -1)
+
+                    mk, _ = jax.lax.while_loop(wcond, wbody, (mk, fr))
+                    return i + 1, mk
+
+                maxpos = jnp.max(pool["pend_pos"])
+                _, mk = jax.lax.while_loop(
+                    lambda c: c[0] * CH < jnp.minimum(maxpos, M),
+                    walk_chunk,
+                    (jnp.int32(0), jnp.zeros((B + 1, K), bool)),
+                )
+                pinned = mk[:B]
                 csum = jnp.cumsum(pinned.astype(jnp.int32), axis=0)
                 pcount = csum[-1]                          # [K]
                 remap = jnp.where(pinned, csum - 1, -1)    # [B, K]
                 remap_full = jnp.concatenate(
-                    [remap, jnp.full((1,) + remap.shape[1:], -1, jnp.int32)]
+                    [remap, jnp.full((1, K), -1, jnp.int32)]
                 )
 
                 def remap_vals_1(r, ids):
@@ -885,7 +951,6 @@ class BatchedDeviceNFA:
 
                 remap_vals = jax.vmap(remap_vals_1, in_axes=-1, out_axes=-1)
                 prank = jnp.where(pinned, csum - 1, B)     # holes -> trash
-                kk = jnp.arange(pinned.shape[1])[None, :]
 
                 def compact_by(vals):
                     out = jnp.full((B + 1,) + vals.shape[1:], -1, vals.dtype)
@@ -893,11 +958,19 @@ class BatchedDeviceNFA:
                         jnp.where(pinned, vals, -1)
                     )[:B]
 
-                pend_r = remap_vals(remap_full, pool["pend"])
-                ev = compact_by(pool["node_event"])
-                nm = compact_by(pool["node_name"])
-                pr = compact_by(remap_vals(remap_full, pool["node_pred"]))
-                return pend_r, ev, nm, pr, pcount
+                from ..ops.engine import remap_pend_blocks
+
+                pend_r = remap_pend_blocks(
+                    pool["pend"], remap_full, pool["pend_pos"]
+                )
+                nodes3 = jnp.stack(
+                    [
+                        compact_by(pool["node_event"]),
+                        compact_by(pool["node_name"]),
+                        compact_by(remap_vals(remap_full, pool["node_pred"])),
+                    ]
+                )
+                return pend_r, nodes3, pcount
 
             self._drain_compact_fn = drain_compact
         return self._drain_compact_fn
@@ -913,36 +986,28 @@ class BatchedDeviceNFA:
         device (`_drain_compact` -- exactly the pend-reachable closure),
         then sliced at pow2(max pinned count) so the D2H transfer tracks
         pending-match volume, not region capacity, and the number of
-        distinct sliced programs stays O(log B). The pull rides a
-        ~100 MB/s tunnel with ~0.1-0.2 s per-transfer overhead, so both
-        bytes and transfer count are the cost (PERF.md).
+        distinct sliced programs stays O(log B). The pull rides a tunnel
+        measured at ~10 MB/s effective for fresh buffers with ~0.1-0.2 s
+        per-transfer overhead, so both bytes and transfer count are the
+        cost (PERF.md "v7").
         """
-        # One fused [3, K] probe: pending counts + pinned closure sizes +
-        # ring cursors (one tunnel round-trip for everything the drain's
-        # host logic needs).
+        # One small [2, K] probe decides everything cheap: pending counts
+        # and ring cursors.
         if self._drain_counts_fn is None:
             self._drain_counts_fn = jax.jit(
-                lambda p: jnp.stack(
-                    [p["pend_count"],
-                     jnp.sum(p["pinned"].astype(jnp.int32), axis=0),
-                     p["pend_pos"]]
-                )
+                lambda p: jnp.stack([p["pend_count"], p["pend_pos"]])
             )
         both = np.asarray(self._drain_counts_fn(self.pool))
         counts = both[0]
         self.last_match_counts = counts
         if counts.sum() == 0:
-            if int(both[2].max()) > 0:
+            if int(both[1].max()) > 0:
                 self.pool = self._drain_pend(self.pool)  # reclaim cursor
             self._ring_cleared()
             return None
         full_b = self.pool["node_event"].shape[0]
         full_m = self.pool["pend"].shape[0]
-        Bb = 1
-        while Bb < max(int(both[1].max()), 1):
-            Bb <<= 1
-        Bb = min(Bb, full_b)
-        pend_r, ev, nm, pr, _ = self._drain_compact()(self.pool)
+        pend_r, nodes3, pcount = self._drain_compact()(self.pool)
         # The ring may still carry holes between keys' counts: compact
         # valid ids to a per-key prefix so the pend pull is pow2(max
         # count) wide.
@@ -953,16 +1018,21 @@ class BatchedDeviceNFA:
                 lambda p: compact_valid_front(p)[0]
             )
         compacted = self._compact_pend_fn(pend_r)
+        Bb = 1
+        while Bb < max(int(np.asarray(pcount).max()), 1):
+            Bb <<= 1
+        Bb = min(Bb, full_b)
         Mb = 1
         while Mb < max(int(counts.max()), 1):
             Mb <<= 1
         Mb = min(Mb, full_m)
+        pulled = np.asarray(nodes3[:, :Bb])            # one [3, Bb, K] pull
         raw = {
             "counts": counts,
             "pend": np.asarray(compacted[:Mb]).T,      # [K, Mb]
-            "node_event": np.asarray(ev[:Bb]).T,       # [K, Bb] pinned-rank
-            "node_name": np.asarray(nm[:Bb]).T,
-            "node_pred": np.asarray(pr[:Bb]).T,
+            "node_event": pulled[0].T,                 # [K, Bb] closure-rank
+            "node_name": pulled[1].T,
+            "node_pred": pulled[2].T,
         }
         self.pool = self._drain_pend(self.pool)
         self._ring_cleared()

@@ -102,7 +102,9 @@ def build_batched_post(query: CompiledQuery, config: EngineConfig):
 
     @jax.jit
     def post(state, pool, ys):
-        state, pool, page_roots = append(state, pool, ys["w_match"])
+        state, pool, page_roots = append(
+            state, pool, ys["w_match"], ys["w_mroot"]
+        )
         state, pool, remap_full = gc(state, pool, ys, page_roots)
         pool = {
             **pool,

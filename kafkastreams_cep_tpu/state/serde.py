@@ -30,11 +30,12 @@ from .aggregates import AggregatesStore
 from .buffer import BufferNode, BufferStore, SharedVersionedBuffer
 from .nfa_store import NFAStates, NFAStore
 
-MAGIC = b"KCT4"  # format tag + version (4: paged pend ring -- pool carries
-                 # pend_pos + pinned leaves; 3: batched leaves key-axis-last)
-#: still-readable prior versions: KCT3 differs only by the pool's missing
-#: pend_pos/pinned leaves, which `upgrade_pool_tree` synthesizes on load.
-COMPAT_MAGIC = (b"KCT3",)
+MAGIC = b"KCT5"  # format tag + version (5: interval pinning -- pool carries
+                 # pend_min, state carries per-lane chain roots; 4: paged
+                 # pend ring; 3: batched leaves key-axis-last)
+#: still-readable prior versions: missing leaves are synthesized on load
+#: (`upgrade_pool_tree` / `upgrade_state_tree`).
+COMPAT_MAGIC = (b"KCT3", b"KCT4")
 
 
 def read_magic(r: "_Reader") -> int:
@@ -85,6 +86,54 @@ def upgrade_pool_tree(pool: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
             pinned[:, k] = closure(pend[:, k], pred[:, k])
         pool["pinned"] = pinned
     return pool
+
+
+#: `pend_min` sentinel (engine._PEND_MIN_NONE): no pending match.
+_PEND_MIN_NONE = np.int32(2**31 - 1)
+
+
+def _chain_roots(node: np.ndarray, pred: np.ndarray) -> np.ndarray:
+    """Follow predecessor pointers host-side: the chain root of each
+    lane's last node (vectorized pointer-jumping; -1 stays -1)."""
+    root = node.astype(np.int32).copy()
+    while True:
+        live = root >= 0
+        if not live.any():
+            break
+        nxt = np.where(live, pred[np.clip(root, 0, None)], -1)
+        step = live & (nxt >= 0)
+        if not step.any():
+            break
+        root = np.where(step, nxt, root)
+    return root
+
+
+def upgrade_checkpoint_trees(
+    state: Dict[str, np.ndarray], pool: Dict[str, np.ndarray]
+) -> None:
+    """Upgrade KCT3/KCT4 trees in place to the KCT5 schema: synthesize the
+    pool's `pend_min` (min pinned node id -- pinned IS the pend-reachable
+    set, whose minimum bounds every pending chain) and the state's
+    per-lane chain roots (a host-side predecessor walk)."""
+    upgrade_pool_tree(pool)
+    if "pend_min" not in pool:
+        pinned = np.asarray(pool["pinned"])
+        any_pin = pinned.any(axis=0)
+        first = np.argmax(pinned, axis=0).astype(np.int32)
+        pool["pend_min"] = np.where(any_pin, first, _PEND_MIN_NONE).astype(
+            np.int32
+        )
+    if "root" not in state:
+        node = np.asarray(state["node"])
+        pred = np.asarray(pool["node_pred"])
+        if node.ndim == 1:
+            state["root"] = _chain_roots(node, pred)
+        else:  # [R, K] lanes over [B, K] pools
+            R, K = node.shape
+            root = np.empty((R, K), np.int32)
+            for k in range(K):
+                root[:, k] = _chain_roots(node[:, k], pred[:, k])
+            state["root"] = root
 
 
 def _default_serialize(obj: Any) -> bytes:

@@ -273,6 +273,37 @@ def test_dense_append_defers_host_drains_on_sparse_matches():
     assert {k: len(v) for k, v in out.items()} == {k: n_batches for k in keys}
 
 
+def test_pin_interval_matches_precise_walks():
+    """pin_interval=True replaces the GC's page-root walks with an
+    id-interval bound; it may retain MORE garbage but must never change
+    observable output: matches across mid-run and final drains equal the
+    precise-walk engine's, with zero drops on both."""
+    pattern = branching_pattern()
+    stages = compile_pattern(pattern)
+    keys = [f"k{i}" for i in range(4)]
+    streams = {k: letter_stream(300 + i, 12) for i, k in enumerate(keys)}
+
+    def run(pin):
+        config = EngineConfig(
+            lanes=32, nodes=512, matches=256, matches_per_step=8,
+            pin_interval=pin,
+        )
+        bat = BatchedDeviceNFA(stages, keys=keys, config=config)
+        got = {k: [] for k in keys}
+        for lo, hi in ((0, 4), (4, 8), (8, 100)):
+            chunk = {k: s[lo:hi] for k, s in streams.items() if s[lo:hi]}
+            bat.advance_packed(bat.pack(chunk), decode=False)
+        # One deferred drain after several undrained advances (the pin
+        # machinery's whole job), then a final drain.
+        for k, seqs in bat.drain().items():
+            got[k].extend(seqs)
+        st = bat.stats
+        assert st["node_drops"] == 0 and st["match_drops"] == 0
+        return got
+
+    assert run(True) == run(False)
+
+
 def test_pallas_sharded_over_mesh():
     """The fused kernel shard_maps over the key axis: engine="pallas_interpret"
     + mesh must equal the unsharded XLA run (VERDICT r4 missing #3 -- the

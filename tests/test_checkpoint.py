@@ -73,6 +73,40 @@ def letter_stream(n):
     ]
 
 
+def test_kct4_checkpoint_upgrade_synthesizes_roots_and_pend_min():
+    """A pre-KCT5 checkpoint lacks the state's per-lane chain roots and
+    the pool's `pend_min`; the upgrade must synthesize both so restored
+    engines keep the interval-pinning invariants (root = oldest chain
+    node; pend_min bounds every pinned id)."""
+    from kafkastreams_cep_tpu.state.serde import (
+        _PEND_MIN_NONE,
+        upgrade_checkpoint_trees,
+    )
+
+    dev = DeviceNFA(
+        compile_query(compile_pattern(branching_pattern())), config=CONFIG
+    )
+    stream = letter_stream(48)
+    dev.advance(stream[:24], decode=False)  # leave matches pending
+    state = {k: np.asarray(v) for k, v in dev.state.items()}
+    pool = {k: np.asarray(v) for k, v in dev.pool.items()}
+    want_root = state["root"]
+    want_min = int(pool["pend_min"])
+    # Strip the KCT5-only leaves, as a KCT4 writer would have.
+    state_old = {k: v for k, v in state.items() if k != "root"}
+    pool_old = {k: v for k, v in pool.items() if k != "pend_min"}
+    upgrade_checkpoint_trees(state_old, pool_old)
+    assert (state_old["root"] == want_root).all()
+    got_min = int(pool_old["pend_min"])
+    if want_min == int(_PEND_MIN_NONE):
+        assert got_min == int(_PEND_MIN_NONE)
+    else:
+        # The synthesized bound is the min pinned id: at least as tight a
+        # lower bound as the engine's running min of placed roots.
+        assert got_min <= want_min
+        assert pool["pinned"][got_min]
+
+
 def test_host_processor_checkpoint_resume(tmp_path):
     """Process half the golden stream, snapshot, restore into a fresh
     processor (recompiled pattern), finish: matches identical."""
