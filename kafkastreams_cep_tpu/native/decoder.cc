@@ -1,14 +1,19 @@
-// Native match decoder: pulled node pools -> materialized Sequence objects.
+// Native match decoder: pulled drain snapshots -> materialized Sequences.
 //
 // The reference materializes a match by walking the shared versioned
 // buffer's pointers backwards per match (reference:
 // core/.../cep/state/internal/SharedVersionedBufferStoreImpl.java:164-201);
-// the TPU-native drain pulls the compacted node pools off the device once
-// and walks every chain host-side. The pure-Python walk + Sequence assembly
-// costs ~30 us per match (PERF.md round-4 "Where the end-to-end time goes
-// now") and dominates end-to-end throughput on match-heavy workloads; this
-// CPython extension does the chain walk, stage grouping, normalization
-// check and Staged/Sequence construction in one C call per drain.
+// the TPU-native drain either (a) pulls the compacted node pools off the
+// device once and walks every chain host-side (`decode_matches`, the
+// original path) or (b) walks the chains ON DEVICE into a dense
+// [match, hop] table (ops/engine.py build_chain_flatten) so the C side is
+// a flat loop over rows with no pointer chasing (`decode_matches_flat`,
+// the default drain path since the chain-flatten rewrite). The pure-Python
+// walk + Sequence assembly costs ~30 us per match (PERF.md round-4 "Where
+// the end-to-end time goes now") and dominates end-to-end throughput on
+// match-heavy workloads; this CPython extension does the chain walk/read,
+// stage grouping, normalization check and Staged/Sequence construction in
+// one C call per drain.
 //
 // Semantics are exactly ops/runtime.py decode_chains + materialize_sequence
 // (which remain the fallback and the semantic reference):
@@ -74,6 +79,42 @@ bool get_i32_2d(PyObject* obj, const char* what, Buf* b, View2D* v,
   return true;
 }
 
+// Strided 3D int32 view: the flat drain pulls one [3, M, C, K] table and
+// hands per-plane [K, M, C] transposes here (numpy moveaxis views), so
+// contiguity must not be required.
+struct View3D {
+  const char* data = nullptr;
+  Py_ssize_t s0 = 0, s1 = 0, s2 = 0;
+
+  int32_t at(Py_ssize_t i, Py_ssize_t j, Py_ssize_t c) const {
+    return *reinterpret_cast<const int32_t*>(data + i * s0 + j * s1 +
+                                             c * s2);
+  }
+};
+
+bool get_i32_3d(PyObject* obj, const char* what, Buf* b, View3D* v,
+                Py_ssize_t* d0, Py_ssize_t* d1, Py_ssize_t* d2) {
+  if (PyObject_GetBuffer(obj, &b->buf, PyBUF_STRIDES) < 0) return false;
+  b->held = true;
+  if (b->buf.ndim != 3 || b->buf.itemsize != 4) {
+    PyErr_Format(PyExc_ValueError, "%s must be int32 [K, M, C]", what);
+    return false;
+  }
+  Py_ssize_t* dims[3] = {d0, d1, d2};
+  for (int i = 0; i < 3; ++i) {
+    if (*dims[i] < 0) *dims[i] = b->buf.shape[i];
+    if (b->buf.shape[i] != *dims[i]) {
+      PyErr_Format(PyExc_ValueError, "%s shape mismatch", what);
+      return false;
+    }
+  }
+  v->data = static_cast<const char*>(b->buf.buf);
+  v->s0 = b->buf.strides[0];
+  v->s1 = b->buf.strides[1];
+  v->s2 = b->buf.strides[2];
+  return true;
+}
+
 // A Staged/Sequence instance without running Python-level __init__
 // (the C analog of cls.__new__(cls)).
 PyObject* bare_instance(PyObject* type) {
@@ -84,6 +125,259 @@ PyObject* bare_instance(PyObject* type) {
   Py_DECREF(empty);
   return obj;
 }
+
+// Shared chain -> Sequence materialization. Both decode entry points feed
+// NEWEST-FIRST (name_id << 32 | gidx) chains here (the walk order);
+// assembly iterates them reversed, so groups build oldest-first exactly as
+// ops/runtime.py materialize_sequence does.
+struct Materializer {
+  PyObject* name_of_id = nullptr;     // borrowed
+  PyObject* registry = nullptr;       // borrowed
+  PyObject* staged_type = nullptr;    // borrowed
+  PyObject* sequence_type = nullptr;  // borrowed
+  const int32_t* qid_of_name = nullptr;
+  Py_ssize_t n_qids = 0;
+  Py_ssize_t n_names = 0;
+  std::vector<int32_t> canon;
+  PyObject* s_topic = nullptr;
+  PyObject* s_partition = nullptr;
+  PyObject* s_offset = nullptr;
+  PyObject* s_stage = nullptr;
+  PyObject* s_events_attr = nullptr;
+  PyObject* s_matched = nullptr;
+  PyObject* s_by_name = nullptr;
+
+  struct Group {
+    int32_t canon_id;
+    PyObject* name;    // borrowed from name_of_id
+    PyObject* events;  // owned list
+  };
+  std::vector<Group> groups;  // scratch reused across matches
+
+  // `qid_b` is caller-owned so the qid buffer outlives this object.
+  bool init(PyObject* name_of_id_, PyObject* registry_, PyObject* staged_,
+            PyObject* sequence_, PyObject* qid_obj, Buf* qid_b) {
+    if (!PyList_Check(name_of_id_) || !PyDict_Check(registry_) ||
+        !PyType_Check(staged_) || !PyType_Check(sequence_)) {
+      PyErr_SetString(PyExc_TypeError,
+                      "name_of_id list, registry dict, Staged/Sequence types");
+      return false;
+    }
+    name_of_id = name_of_id_;
+    registry = registry_;
+    staged_type = staged_;
+    sequence_type = sequence_;
+
+    if (qid_obj != Py_None) {
+      if (PyObject_GetBuffer(qid_obj, &qid_b->buf, PyBUF_C_CONTIGUOUS) < 0) {
+        return false;
+      }
+      qid_b->held = true;
+      if (qid_b->buf.ndim != 1 || qid_b->buf.itemsize != 4) {
+        PyErr_SetString(PyExc_ValueError, "qid_of_name_id must be int32 [N]");
+        return false;
+      }
+      qid_of_name = static_cast<const int32_t*>(qid_b->buf.buf);
+      n_qids = qid_b->buf.shape[0];
+    }
+
+    // name_id -> canonical group id: ids whose name strings compare equal
+    // share a group (grouping is by NAME, not id).
+    n_names = PyList_GET_SIZE(name_of_id);
+    canon.assign(n_names, 0);
+    for (Py_ssize_t i = 0; i < n_names; ++i) {
+      canon[i] = static_cast<int32_t>(i);
+      PyObject* ni = PyList_GET_ITEM(name_of_id, i);
+      for (Py_ssize_t j = 0; j < i; ++j) {
+        int eq =
+            PyObject_RichCompareBool(ni, PyList_GET_ITEM(name_of_id, j), Py_EQ);
+        if (eq < 0) return false;
+        if (eq) {
+          canon[i] = canon[j];
+          break;
+        }
+      }
+    }
+
+    s_topic = PyUnicode_InternFromString("topic");
+    s_partition = PyUnicode_InternFromString("partition");
+    s_offset = PyUnicode_InternFromString("offset");
+    s_stage = PyUnicode_InternFromString("stage");
+    s_events_attr = PyUnicode_InternFromString("_events");
+    s_matched = PyUnicode_InternFromString("matched");
+    s_by_name = PyUnicode_InternFromString("_by_name");
+    return s_topic && s_partition && s_offset && s_stage && s_events_attr &&
+           s_matched && s_by_name;
+  }
+
+  void fini() {
+    Py_XDECREF(s_topic);
+    Py_XDECREF(s_partition);
+    Py_XDECREF(s_offset);
+    Py_XDECREF(s_stage);
+    Py_XDECREF(s_events_attr);
+    Py_XDECREF(s_matched);
+    Py_XDECREF(s_by_name);
+  }
+
+  // Materialize one chain and append the Sequence (or (qid, Sequence)
+  // pair) to per_key. Returns false with a Python error set.
+  bool emit(const std::vector<int64_t>& chain, PyObject* per_key) {
+    bool fail = false;
+    // Oldest-first group assembly, first-occurrence stage order.
+    groups.clear();
+    for (size_t c = chain.size(); c-- > 0 && !fail;) {
+      int32_t name_id = static_cast<int32_t>(chain[c] >> 32);
+      int32_t gidx = static_cast<int32_t>(chain[c] & 0xffffffff);
+      if (name_id < 0 || name_id >= n_names) {
+        PyErr_Format(PyExc_ValueError, "bad stage name id %d", name_id);
+        fail = true;
+        break;
+      }
+      int32_t cid = canon[name_id];
+      Group* grp = nullptr;
+      for (auto& g2 : groups) {
+        if (g2.canon_id == cid) {
+          grp = &g2;
+          break;
+        }
+      }
+      if (grp == nullptr) {
+        PyObject* lst = PyList_New(0);
+        if (lst == nullptr) {
+          fail = true;
+          break;
+        }
+        groups.push_back(Group{cid, PyList_GET_ITEM(name_of_id, cid), lst});
+        grp = &groups.back();
+      }
+      PyObject* g_obj = PyLong_FromLong(gidx);
+      if (g_obj == nullptr) {
+        fail = true;
+        break;
+      }
+      PyObject* event = PyDict_GetItemWithError(registry, g_obj);  // borrowed
+      Py_DECREF(g_obj);
+      if (event == nullptr) {
+        if (!PyErr_Occurred()) {
+          PyErr_Format(PyExc_KeyError, "event registry missing gidx %d", gidx);
+        }
+        fail = true;
+        break;
+      }
+      if (PyList_Append(grp->events, event) < 0) fail = true;
+    }
+
+    PyObject* matched = fail ? nullptr : PyList_New(0);
+    if (matched == nullptr) fail = true;
+    for (auto& grp : groups) {
+      if (fail) {
+        Py_XDECREF(grp.events);
+        continue;
+      }
+      // Normalized exactly when all events share one (topic, partition)
+      // and offsets strictly increase -- then Staged's sorted(set(...))
+      // is the identity and can be skipped.
+      Py_ssize_t ne = PyList_GET_SIZE(grp.events);
+      bool normalized = true;
+      PyObject* topic0 = nullptr;
+      long long part0 = 0, prev_off = 0;
+      for (Py_ssize_t i2 = 0; i2 < ne && normalized; ++i2) {
+        PyObject* e = PyList_GET_ITEM(grp.events, i2);
+        PyObject* topic = PyObject_GetAttr(e, s_topic);
+        PyObject* part = topic ? PyObject_GetAttr(e, s_partition) : nullptr;
+        PyObject* off = part ? PyObject_GetAttr(e, s_offset) : nullptr;
+        if (off == nullptr) {
+          Py_XDECREF(topic);
+          Py_XDECREF(part);
+          fail = true;
+          break;
+        }
+        long long part_v = PyLong_AsLongLong(part);
+        long long off_v = PyLong_AsLongLong(off);
+        if ((part_v == -1 || off_v == -1) && PyErr_Occurred()) {
+          // Non-int partition/offset: fall back to the Python ctor.
+          PyErr_Clear();
+          normalized = false;
+        } else if (i2 == 0) {
+          topic0 = topic;
+          Py_INCREF(topic0);
+          part0 = part_v;
+          prev_off = off_v;
+        } else {
+          int teq = PyObject_RichCompareBool(topic, topic0, Py_EQ);
+          if (teq < 0) {
+            fail = true;
+          } else if (!teq || part_v != part0 || off_v <= prev_off) {
+            normalized = false;
+          }
+          prev_off = off_v;
+        }
+        Py_DECREF(topic);
+        Py_DECREF(part);
+        Py_DECREF(off);
+      }
+      Py_XDECREF(topic0);
+
+      PyObject* staged = nullptr;
+      if (!fail && normalized) {
+        staged = bare_instance(staged_type);
+        if (staged == nullptr || PyObject_SetAttr(staged, s_stage, grp.name) < 0 ||
+            PyObject_SetAttr(staged, s_events_attr, grp.events) < 0) {
+          fail = true;
+        }
+      } else if (!fail) {
+        staged = PyObject_CallFunctionObjArgs(staged_type, grp.name, grp.events,
+                                              nullptr);
+        if (staged == nullptr) fail = true;
+      }
+      Py_DECREF(grp.events);
+      if (!fail && PyList_Append(matched, staged) < 0) fail = true;
+      Py_XDECREF(staged);
+    }
+    groups.clear();
+    if (fail) {
+      Py_XDECREF(matched);
+      return false;
+    }
+
+    // Sequence.__init__ is matched + a stage->Staged dict; build both
+    // here so no Python frame runs per match.
+    PyObject* by_name = PyDict_New();
+    PyObject* seq = by_name ? bare_instance(sequence_type) : nullptr;
+    if (seq == nullptr) {
+      Py_XDECREF(by_name);
+      Py_DECREF(matched);
+      return false;
+    }
+    Py_ssize_t n_groups = PyList_GET_SIZE(matched);
+    for (Py_ssize_t i2 = 0; i2 < n_groups && !fail; ++i2) {
+      PyObject* st = PyList_GET_ITEM(matched, i2);
+      PyObject* nm = PyObject_GetAttr(st, s_stage);
+      if (nm == nullptr || PyDict_SetItem(by_name, nm, st) < 0) fail = true;
+      Py_XDECREF(nm);
+    }
+    if (!fail && (PyObject_SetAttr(seq, s_matched, matched) < 0 ||
+                  PyObject_SetAttr(seq, s_by_name, by_name) < 0)) {
+      fail = true;
+    }
+    Py_DECREF(by_name);
+    Py_DECREF(matched);
+    if (!fail && qid_of_name != nullptr) {
+      // Stacked-query attribution: chains never span queries, so any
+      // chain node's name id identifies the owner.
+      int32_t nm0 = static_cast<int32_t>(chain[0] >> 32);
+      long qid = (nm0 >= 0 && nm0 < n_qids) ? qid_of_name[nm0] : -1;
+      PyObject* pair = Py_BuildValue("(lO)", qid, seq);
+      if (pair == nullptr || PyList_Append(per_key, pair) < 0) fail = true;
+      Py_XDECREF(pair);
+    } else if (!fail && PyList_Append(per_key, seq) < 0) {
+      fail = true;
+    }
+    Py_DECREF(seq);
+    return !fail;
+  }
+};
 
 // decode_matches(counts, pend, node_event, node_name, node_pred, name_of_id,
 //                registry, staged_type, sequence_type[, qid_of_name_id])
@@ -97,12 +391,6 @@ PyObject* decode_matches(PyObject*, PyObject* args) {
   if (!PyArg_ParseTuple(args, "OOOOOOOOO|O", &counts_obj, &pend_obj, &ev_obj,
                         &nm_obj, &pr_obj, &name_of_id, &registry, &staged_type,
                         &sequence_type, &qid_obj)) {
-    return nullptr;
-  }
-  if (!PyList_Check(name_of_id) || !PyDict_Check(registry) ||
-      !PyType_Check(staged_type) || !PyType_Check(sequence_type)) {
-    PyErr_SetString(PyExc_TypeError,
-                    "name_of_id list, registry dict, Staged/Sequence types");
     return nullptr;
   }
 
@@ -133,47 +421,10 @@ PyObject* decode_matches(PyObject*, PyObject* args) {
   const auto* counts = static_cast<const int32_t*>(counts_b.buf.buf);
 
   Buf qid_b;
-  const int32_t* qid_of_name = nullptr;
-  Py_ssize_t n_qids = 0;
-  if (qid_obj != Py_None) {
-    if (PyObject_GetBuffer(qid_obj, &qid_b.buf, PyBUF_C_CONTIGUOUS) < 0) {
-      return nullptr;
-    }
-    qid_b.held = true;
-    if (qid_b.buf.ndim != 1 || qid_b.buf.itemsize != 4) {
-      PyErr_SetString(PyExc_ValueError, "qid_of_name_id must be int32 [N]");
-      return nullptr;
-    }
-    qid_of_name = static_cast<const int32_t*>(qid_b.buf.buf);
-    n_qids = qid_b.buf.shape[0];
-  }
-
-  // name_id -> canonical group id: ids whose name strings compare equal
-  // share a group (grouping is by NAME, not id).
-  Py_ssize_t n_names = PyList_GET_SIZE(name_of_id);
-  std::vector<int32_t> canon(n_names, 0);
-  for (Py_ssize_t i = 0; i < n_names; ++i) {
-    canon[i] = static_cast<int32_t>(i);
-    PyObject* ni = PyList_GET_ITEM(name_of_id, i);
-    for (Py_ssize_t j = 0; j < i; ++j) {
-      int eq = PyObject_RichCompareBool(ni, PyList_GET_ITEM(name_of_id, j), Py_EQ);
-      if (eq < 0) return nullptr;
-      if (eq) {
-        canon[i] = canon[j];
-        break;
-      }
-    }
-  }
-
-  PyObject* s_topic = PyUnicode_InternFromString("topic");
-  PyObject* s_partition = PyUnicode_InternFromString("partition");
-  PyObject* s_offset = PyUnicode_InternFromString("offset");
-  PyObject* s_stage = PyUnicode_InternFromString("stage");
-  PyObject* s_events_attr = PyUnicode_InternFromString("_events");
-  PyObject* s_matched = PyUnicode_InternFromString("matched");
-  PyObject* s_by_name = PyUnicode_InternFromString("_by_name");
-  if (!s_topic || !s_partition || !s_offset || !s_stage || !s_events_attr ||
-      !s_matched || !s_by_name) {
+  Materializer mat;
+  if (!mat.init(name_of_id, registry, staged_type, sequence_type, qid_obj,
+                &qid_b)) {
+    mat.fini();
     return nullptr;
   }
 
@@ -181,14 +432,8 @@ PyObject* decode_matches(PyObject*, PyObject* args) {
   bool fail = out == nullptr;
 
   // Scratch reused across matches: the chain as (name_id, gidx) pairs
-  // (newest-first as walked, consumed oldest-first), and the group table.
+  // (newest-first as walked, consumed oldest-first by the materializer).
   std::vector<int64_t> chain;
-  struct Group {
-    int32_t canon_id;
-    PyObject* name;    // borrowed from name_of_id
-    PyObject* events;  // owned list
-  };
-  std::vector<Group> groups;
 
   for (Py_ssize_t k = 0; k < K && !fail; ++k) {
     PyObject* per_key = PyList_New(0);
@@ -213,172 +458,93 @@ PyObject* decode_matches(PyObject*, PyObject* args) {
         cur = node_pred.at(k, cur);
       }
       if (chain.empty()) continue;  // GC-dropped (node_drops counts it)
-
-      // Oldest-first group assembly, first-occurrence stage order.
-      groups.clear();
-      for (size_t c = chain.size(); c-- > 0 && !fail;) {
-        int32_t name_id = static_cast<int32_t>(chain[c] >> 32);
-        int32_t gidx = static_cast<int32_t>(chain[c] & 0xffffffff);
-        if (name_id < 0 || name_id >= n_names) {
-          PyErr_Format(PyExc_ValueError, "bad stage name id %d", name_id);
-          fail = true;
-          break;
-        }
-        int32_t cid = canon[name_id];
-        Group* grp = nullptr;
-        for (auto& g2 : groups) {
-          if (g2.canon_id == cid) {
-            grp = &g2;
-            break;
-          }
-        }
-        if (grp == nullptr) {
-          PyObject* lst = PyList_New(0);
-          if (lst == nullptr) {
-            fail = true;
-            break;
-          }
-          groups.push_back(
-              Group{cid, PyList_GET_ITEM(name_of_id, cid), lst});
-          grp = &groups.back();
-        }
-        PyObject* g_obj = PyLong_FromLong(gidx);
-        if (g_obj == nullptr) {
-          fail = true;
-          break;
-        }
-        PyObject* event = PyDict_GetItemWithError(registry, g_obj);  // borrowed
-        Py_DECREF(g_obj);
-        if (event == nullptr) {
-          if (!PyErr_Occurred()) {
-            PyErr_Format(PyExc_KeyError, "event registry missing gidx %d",
-                         gidx);
-          }
-          fail = true;
-          break;
-        }
-        if (PyList_Append(grp->events, event) < 0) fail = true;
-      }
-
-      PyObject* matched = fail ? nullptr : PyList_New(0);
-      if (matched == nullptr) fail = true;
-      for (auto& grp : groups) {
-        if (fail) {
-          Py_XDECREF(grp.events);
-          continue;
-        }
-        // Normalized exactly when all events share one (topic, partition)
-        // and offsets strictly increase -- then Staged's sorted(set(...))
-        // is the identity and can be skipped.
-        Py_ssize_t ne = PyList_GET_SIZE(grp.events);
-        bool normalized = true;
-        PyObject* topic0 = nullptr;
-        long long part0 = 0, prev_off = 0;
-        for (Py_ssize_t i2 = 0; i2 < ne && normalized; ++i2) {
-          PyObject* e = PyList_GET_ITEM(grp.events, i2);
-          PyObject* topic = PyObject_GetAttr(e, s_topic);
-          PyObject* part = topic ? PyObject_GetAttr(e, s_partition) : nullptr;
-          PyObject* off = part ? PyObject_GetAttr(e, s_offset) : nullptr;
-          if (off == nullptr) {
-            Py_XDECREF(topic);
-            Py_XDECREF(part);
-            fail = true;
-            break;
-          }
-          long long part_v = PyLong_AsLongLong(part);
-          long long off_v = PyLong_AsLongLong(off);
-          if ((part_v == -1 || off_v == -1) && PyErr_Occurred()) {
-            // Non-int partition/offset: fall back to the Python ctor.
-            PyErr_Clear();
-            normalized = false;
-          } else if (i2 == 0) {
-            topic0 = topic;
-            Py_INCREF(topic0);
-            part0 = part_v;
-            prev_off = off_v;
-          } else {
-            int teq = PyObject_RichCompareBool(topic, topic0, Py_EQ);
-            if (teq < 0) {
-              fail = true;
-            } else if (!teq || part_v != part0 || off_v <= prev_off) {
-              normalized = false;
-            }
-            prev_off = off_v;
-          }
-          Py_DECREF(topic);
-          Py_DECREF(part);
-          Py_DECREF(off);
-        }
-        Py_XDECREF(topic0);
-
-        PyObject* staged = nullptr;
-        if (!fail && normalized) {
-          staged = bare_instance(staged_type);
-          if (staged == nullptr ||
-              PyObject_SetAttr(staged, s_stage, grp.name) < 0 ||
-              PyObject_SetAttr(staged, s_events_attr, grp.events) < 0) {
-            fail = true;
-          }
-        } else if (!fail) {
-          staged = PyObject_CallFunctionObjArgs(staged_type, grp.name,
-                                                grp.events, nullptr);
-          if (staged == nullptr) fail = true;
-        }
-        Py_DECREF(grp.events);
-        if (!fail && PyList_Append(matched, staged) < 0) fail = true;
-        Py_XDECREF(staged);
-      }
-      groups.clear();
-      if (fail) {
-        Py_XDECREF(matched);
-        break;
-      }
-
-      // Sequence.__init__ is matched + a stage->Staged dict; build both
-      // here so no Python frame runs per match.
-      PyObject* by_name = PyDict_New();
-      PyObject* seq = by_name ? bare_instance(sequence_type) : nullptr;
-      if (seq == nullptr) {
-        Py_XDECREF(by_name);
-        Py_DECREF(matched);
-        fail = true;
-        break;
-      }
-      Py_ssize_t n_groups = PyList_GET_SIZE(matched);
-      for (Py_ssize_t i2 = 0; i2 < n_groups && !fail; ++i2) {
-        PyObject* st = PyList_GET_ITEM(matched, i2);
-        PyObject* nm = PyObject_GetAttr(st, s_stage);
-        if (nm == nullptr || PyDict_SetItem(by_name, nm, st) < 0) fail = true;
-        Py_XDECREF(nm);
-      }
-      if (!fail && (PyObject_SetAttr(seq, s_matched, matched) < 0 ||
-                    PyObject_SetAttr(seq, s_by_name, by_name) < 0)) {
-        fail = true;
-      }
-      Py_DECREF(by_name);
-      Py_DECREF(matched);
-      if (!fail && qid_of_name != nullptr) {
-        // Stacked-query attribution: chains never span queries, so any
-        // chain node's name id identifies the owner.
-        int32_t nm0 = static_cast<int32_t>(chain[0] >> 32);
-        long qid = (nm0 >= 0 && nm0 < n_qids) ? qid_of_name[nm0] : -1;
-        PyObject* pair = Py_BuildValue("(lO)", qid, seq);
-        if (pair == nullptr || PyList_Append(per_key, pair) < 0) fail = true;
-        Py_XDECREF(pair);
-      } else if (!fail && PyList_Append(per_key, seq) < 0) {
-        fail = true;
-      }
-      Py_DECREF(seq);
+      if (!mat.emit(chain, per_key)) fail = true;
     }
   }
 
-  Py_DECREF(s_topic);
-  Py_DECREF(s_partition);
-  Py_DECREF(s_offset);
-  Py_DECREF(s_stage);
-  Py_DECREF(s_events_attr);
-  Py_DECREF(s_matched);
-  Py_DECREF(s_by_name);
+  mat.fini();
+  if (fail) {
+    Py_XDECREF(out);
+    return nullptr;
+  }
+  return out;
+}
+
+// decode_matches_flat(counts, gidx, name, live, name_of_id, registry,
+//                     staged_type, sequence_type[, qid_of_name_id])
+//   -> same outputs as decode_matches, from the chain-flattened drain
+//      table (ops/engine.py build_chain_flatten): gidx/name/live are
+//      [K, M, C] int32 planes, hops newest-first; live == 0 ends a chain,
+//      a live hop with gidx < 0 is a GC-dropped put (skipped while the
+//      chain continues). The device already did the pointer walk, so this
+//      is a flat loop over rows.
+PyObject* decode_matches_flat(PyObject*, PyObject* args) {
+  PyObject *counts_obj, *g_obj, *n_obj, *l_obj;
+  PyObject *name_of_id, *registry, *staged_type, *sequence_type;
+  PyObject* qid_obj = Py_None;
+  if (!PyArg_ParseTuple(args, "OOOOOOOO|O", &counts_obj, &g_obj, &n_obj,
+                        &l_obj, &name_of_id, &registry, &staged_type,
+                        &sequence_type, &qid_obj)) {
+    return nullptr;
+  }
+
+  Buf counts_b;
+  if (PyObject_GetBuffer(counts_obj, &counts_b.buf, PyBUF_C_CONTIGUOUS) < 0) {
+    return nullptr;
+  }
+  counts_b.held = true;
+  if (counts_b.buf.ndim != 1 || counts_b.buf.itemsize != 4) {
+    PyErr_SetString(PyExc_ValueError, "counts must be int32 [K]");
+    return nullptr;
+  }
+  Py_ssize_t K = counts_b.buf.shape[0];
+  Py_ssize_t M = -1, C = -1;
+  Buf g_b, n_b, l_b;
+  View3D gidx, name, live;
+  if (!get_i32_3d(g_obj, "gidx", &g_b, &gidx, &K, &M, &C)) return nullptr;
+  if (!get_i32_3d(n_obj, "name", &n_b, &name, &K, &M, &C)) return nullptr;
+  if (!get_i32_3d(l_obj, "live", &l_b, &live, &K, &M, &C)) return nullptr;
+
+  const auto* counts = static_cast<const int32_t*>(counts_b.buf.buf);
+
+  Buf qid_b;
+  Materializer mat;
+  if (!mat.init(name_of_id, registry, staged_type, sequence_type, qid_obj,
+                &qid_b)) {
+    mat.fini();
+    return nullptr;
+  }
+
+  PyObject* out = PyList_New(K);
+  bool fail = out == nullptr;
+  std::vector<int64_t> chain;
+
+  for (Py_ssize_t k = 0; k < K && !fail; ++k) {
+    PyObject* per_key = PyList_New(0);
+    if (per_key == nullptr) {
+      fail = true;
+      break;
+    }
+    PyList_SET_ITEM(out, k, per_key);
+    Py_ssize_t n = counts[k];
+    if (n > M) n = M;
+    for (Py_ssize_t j = 0; j < n && !fail; ++j) {
+      chain.clear();
+      for (Py_ssize_t c = 0; c < C; ++c) {
+        if (!live.at(k, j, c)) break;  // chain ended
+        int32_t g = gidx.at(k, j, c);
+        if (g >= 0) {
+          // Dropped puts (g < 0) skip the hop, not the chain.
+          chain.push_back((static_cast<int64_t>(name.at(k, j, c)) << 32) |
+                          static_cast<uint32_t>(g));
+        }
+      }
+      if (chain.empty()) continue;  // GC-dropped (node_drops counts it)
+      if (!mat.emit(chain, per_key)) fail = true;
+    }
+  }
+
+  mat.fini();
   if (fail) {
     Py_XDECREF(out);
     return nullptr;
@@ -388,8 +554,11 @@ PyObject* decode_matches(PyObject*, PyObject* args) {
 
 PyMethodDef methods[] = {
     {"decode_matches", decode_matches, METH_VARARGS,
-     "Walk per-key match chains and build Sequence objects; returns a list "
-     "of K lists."},
+     "Walk per-key match chains from pulled node pools and build Sequence "
+     "objects; returns a list of K lists."},
+    {"decode_matches_flat", decode_matches_flat, METH_VARARGS,
+     "Build Sequence objects from a chain-flattened drain table "
+     "([K, M, C] gidx/name/live planes); returns a list of K lists."},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -189,12 +189,14 @@ def init_pool(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndarr
     outputs) until the post-advance GC folds the window back into the
     region.
 
-    `pend` is a *paged* pending-match buffer: each advance appends its whole
-    [T * matches_per_step] match-id page at `pend_pos` (one uniform-offset
-    dynamic slice -- O(page), not O(ring), and no per-key scatter), holes
-    kept as -1. `pinned` marks region nodes reachable from already-appended
-    pages so the per-advance GC mark walk only has to traverse the *new*
-    page's chains (frontier O(lanes + page), independent of the ring size).
+    `pend` is a *dense* pending-match buffer: each advance scatter-appends
+    its real match ids at `pend_pos`, the per-key occupancy count (== the
+    true pending-match count; no hole pages -- see build_pend_append).
+    Entries may later be nulled to -1 by a GC under region overflow (dead
+    chains, counted in node_drops), which is the only source of holes.
+    `pinned` marks region nodes reachable from already-appended matches so
+    the per-advance GC mark walk only has to traverse the *new* page's
+    chains (frontier O(lanes + page), independent of the ring size).
     """
     B = config.nodes
     M = config.matches
@@ -838,9 +840,10 @@ def build_pend_append(config: EngineConfig):
         """Fallback when a page exceeds the ring (TM > M): sort the page's
         valid ids to the front and place them at each key's own `pend_pos`
         cursor (no new holes). O(ring) per advance plus a page sort --
-        fine for the single-key runtime and odd batch shapes; the paged
-        path below is the fast one. Both modes share the hole-inclusive
-        `pend_pos` cursor, so they compose on one pool (the device
+        fine for the single-key runtime and odd batch shapes; the dense
+        scatter path below is the fast one. Both modes treat `pend_pos`
+        as the dense per-key occupancy count (== true pending-match
+        count, no hole pages), so they compose on one pool (the device
         processor flushes variable-length partial batches)."""
         TM = ids.shape[0]
         m_valid = ids >= 0
@@ -1203,6 +1206,100 @@ def compact_valid_front(ids: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         kk = jnp.arange(int(np.prod(ids.shape[1:]))).reshape(ids.shape[1:])
         out = out.at[rank, kk].set(jnp.where(m, ids, -1))
     return out[:M], counts
+
+
+def drain_probe(pool: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """Fused drain-time probe: ``[3, K]`` = (pend_count, pend_pos, chain bound).
+
+    Row 2 is an upper bound on the longest pending match chain (in nodes),
+    computed by pointer doubling over the predecessor graph: after
+    ceil(log2(B)) rounds every node knows its full chain length (a corrupt
+    cyclic pool saturates and is clamped to B). The bound is taken over all
+    valid nodes -- a superset of the pend-reachable set, so it can only
+    over-size the flatten table's depth bucket, never truncate a chain.
+    The doubling only runs when something is pending (one `lax.cond`);
+    match-free drains pay the same tiny probe as before.
+
+    This is the ONE host pull the flattened drain needs before sizing the
+    chain-flatten program (build_chain_flatten); everything else rides the
+    single dense table transfer.
+    """
+    pred = pool["node_pred"]
+    B = pred.shape[0]
+    valid = pool["node_event"] >= 0
+
+    def depth_bound(_):
+        d = valid.astype(jnp.int32)
+        j = jnp.where(valid, pred, -1)
+        for _hop in range(max(int(np.ceil(np.log2(max(B, 2)))), 1)):
+            live = j >= 0
+            cj = jnp.clip(j, 0, B - 1)
+            d = d + jnp.where(live, jnp.take_along_axis(d, cj, axis=0), 0)
+            j = jnp.where(live, jnp.take_along_axis(j, cj, axis=0), -1)
+        return jnp.minimum(jnp.max(d, axis=0), B).astype(jnp.int32)
+
+    depth = jax.lax.cond(
+        jnp.sum(pool["pend_count"]) > 0,
+        depth_bound,
+        lambda _: jnp.zeros(pred.shape[1:], jnp.int32),
+        operand=None,
+    )
+    return jnp.stack(
+        [pool["pend_count"], pool["pend_pos"], depth]
+    ).astype(jnp.int32)
+
+
+def build_chain_flatten(max_matches: int, max_chain: int):
+    """Build the jitted drain-time chain flattener.
+
+    At drain time every pending match's predecessor chain is walked ON
+    DEVICE and gathered into one dense table bounded by true match volume:
+
+        table[3, max_matches, max_chain(, K)] int32
+          plane 0: event gidx per hop (-1 for a GC-dropped put's node)
+          plane 1: stage name id per hop
+          plane 2: hop validity (1 while the walk was on a node; the first
+                   0 ends the chain -- distinguishing "chain ended" from
+                   "node present but event dropped", which decode must skip
+                   while continuing, exactly as the pool-walk paths do)
+
+    Hops are stored newest-first (the walk order of
+    ops/runtime.decode_chains and native/decoder.cc); decode reverses.
+    This replaces the drain's node-pool plane pulls entirely: the D2H
+    transfer is this table plus the [3, K] drain_probe, so drain cost
+    tracks matches x chain depth, not pool capacity. `max_matches` /
+    `max_chain` are host-chosen pow2 buckets from the probe, keeping the
+    number of distinct compiled programs O(log M x log B).
+
+    Works on single-key ([M]/[B]) and batched K-last ([M, K]/[B, K]) pools
+    alike. The pend ring is compacted valid-front first so GC-nulled holes
+    (dead chains; node_drops counts them) sit behind each key's count, as
+    in the pool-pull path.
+    """
+    Mb, Cb = max_matches, max_chain
+
+    @jax.jit
+    def flatten(pool: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        compacted, _ = compact_valid_front(pool["pend"])
+        starts = compacted[:Mb]
+        ev = pool["node_event"]
+        nm = pool["node_name"]
+        pr = pool["node_pred"]
+        B = pr.shape[0]
+
+        def hop(cur, _):
+            live = cur >= 0
+            cidx = jnp.clip(cur, 0, B - 1)
+            g = jnp.where(live, jnp.take_along_axis(ev, cidx, axis=0), -1)
+            n = jnp.where(live, jnp.take_along_axis(nm, cidx, axis=0), -1)
+            nxt = jnp.where(live, jnp.take_along_axis(pr, cidx, axis=0), -1)
+            return nxt, jnp.stack([g, n, live.astype(jnp.int32)])
+
+        _, levels = jax.lax.scan(hop, starts, None, length=Cb)
+        # levels [Cb, 3, Mb(, K)] -> [3, Mb, Cb(, K)]
+        return jnp.moveaxis(levels, 0, 2)
+
+    return flatten
 
 
 def drain_pend(pool: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:

@@ -964,7 +964,12 @@ def build_pallas_batched_advance(
                 jax.ShapeDtypeStruct((T, K, M_STEP), jnp.int32),
                 jax.ShapeDtypeStruct((T, K, M_STEP), jnp.int32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            # Pre-0.7 jax names this TPUCompilerParams; fall back so the
+            # kernel builds on both (the CI image ships the old name).
+            compiler_params=getattr(
+                pltpu, "CompilerParams",
+                getattr(pltpu, "TPUCompilerParams", None),
+            )(
                 # Large (lanes, slots, caps) configs need more than the
                 # 16 MB default scoped-VMEM budget for the selection
                 # transients; v5e has headroom above the default.

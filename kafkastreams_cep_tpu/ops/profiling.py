@@ -34,16 +34,37 @@ class BatchTimings:
         self._t_first_undrained: Optional[float] = None
 
     # ------------------------------------------------------------- recording
-    def record_advance(self, seconds: float, slots: int) -> None:
+    def record_advance(
+        self, seconds: float, slots: int, post_s: float = 0.0
+    ) -> None:
         """`slots` is the dispatched [T, K] slot count (padding included) --
         known host-side without a device sync; exact event totals live in
-        the engine's n_events counter."""
+        the engine's n_events counter. `seconds` is the advance dispatch
+        wall, `post_s` the post-pass (pend append + GC) dispatch wall."""
         now = time.perf_counter()
         if self._t_first_undrained is None:
-            self._t_first_undrained = now - seconds
-        self._push(dict(kind=0.0, seconds=seconds, slots=float(slots)))
+            self._t_first_undrained = now - seconds - post_s
+        self._push(
+            dict(
+                kind=0.0, seconds=seconds, slots=float(slots),
+                post_s=post_s,
+            )
+        )
 
-    def record_drain(self, seconds: float, matches: int) -> None:
+    def record_drain(
+        self,
+        seconds: float,
+        matches: int,
+        pull_s: float = 0.0,
+        decode_s: float = 0.0,
+        bytes_pulled: int = 0,
+    ) -> None:
+        """`seconds` spans the blocking drain; `pull_s` is the D2H
+        transfer wall (dispatch -> data landed host-side, np.asarray-
+        forced -- the only trusted completion signal on the axon tunnel,
+        PERF.md "Measurement trap"), `decode_s` the host materialization
+        (possibly on the overlapped worker thread), `bytes_pulled` the
+        actual D2H volume (feeds `tunnel_mbps`)."""
         now = time.perf_counter()
         emit_latency = (
             now - self._t_first_undrained
@@ -54,7 +75,8 @@ class BatchTimings:
         self._push(
             dict(
                 kind=1.0, seconds=seconds, matches=float(matches),
-                emit_latency=emit_latency,
+                emit_latency=emit_latency, pull_s=pull_s,
+                decode_s=decode_s, bytes=float(bytes_pulled),
             )
         )
 
@@ -79,6 +101,39 @@ class BatchTimings:
             "edges_ms": [0.0] + list(bins) + [float("inf")],
             "counts": [int(c) for c in counts],
             "n": int(lat.size),
+        }
+
+    def components(self) -> Dict[str, Any]:
+        """Per-component mean wall per batch/drain (ms) + effective tunnel
+        rate: {advance, post, drain_pull, decode} plus `tunnel_mbps` =
+        total pulled bytes / total D2H wall (None until a drain pulled
+        data). advance/post are DISPATCH walls (sync-free advances
+        pipeline); drain_pull is D2H-forced (np.asarray) and so honest on
+        the axon tunnel, though dispatch->landed includes the flatten
+        pass's device time -- an upper bound on pure transfer."""
+        adv = [r for r in self._records if r["kind"] == 0.0]
+        dr = [r for r in self._records if r["kind"] == 1.0]
+
+        def mean_ms(recs: List[Dict[str, float]], field: str) -> float:
+            if not recs:
+                return 0.0
+            return float(
+                np.mean([r.get(field, 0.0) for r in recs]) * 1e3
+            )
+
+        total_bytes = sum(r.get("bytes", 0.0) for r in dr)
+        total_pull = sum(r.get("pull_s", 0.0) for r in dr)
+        return {
+            "advance_ms": mean_ms(adv, "seconds"),
+            "post_ms": mean_ms(adv, "post_s"),
+            "drain_pull_ms": mean_ms(dr, "pull_s"),
+            "decode_ms": mean_ms(dr, "decode_s"),
+            "drain_bytes": float(total_bytes),
+            "tunnel_mbps": (
+                float(total_bytes / total_pull / 1e6)
+                if total_pull > 0 and total_bytes > 0
+                else None
+            ),
         }
 
     def summary(self) -> Dict[str, float]:

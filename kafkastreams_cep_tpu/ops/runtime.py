@@ -236,20 +236,26 @@ class DeviceNFA:
                 self.query, self.config, snap_state, snap_pool, self._events,
                 ts_base, key,
             )
+            matches: List[Sequence] = []
+            for i, e in enumerate(self._interval_events):
+                ev_gidx[e] = self._interval_start_gidx + i
+                matches.extend(oracle.match_pattern(e))
         except KeyError as exc:
-            # A snapshot event fell out of the registry (or a node was
-            # GC-dropped under region overflow): degrade to detection-only
-            # for this interval rather than crashing the drain -- the
-            # batched driver does the same (parallel/batched.py).
+            # An event fell out of the registry (or a node was GC-dropped
+            # under region overflow) -- in the snapshot rebuild OR in the
+            # oracle feed loop: degrade to detection-only for this interval
+            # rather than crashing the drain (the batched driver does the
+            # same, parallel/batched.py). The degraded interval's matches
+            # are engine-computed, so fold values may diverge from the
+            # oracle for it (the same caveat as the seq_collisions
+            # warning).
             warnings.warn(
-                f"exact-replay skipped: snapshot event {exc} missing from "
-                "the registry; this interval's matches are engine-computed"
+                f"exact-replay skipped: event {exc} missing from the "
+                "registry (snapshot or oracle feed); this interval's "
+                "matches are engine-computed and fold values may diverge "
+                "from the oracle for it"
             )
             return engine_matches
-        matches: List[Sequence] = []
-        for i, e in enumerate(self._interval_events):
-            ev_gidx[e] = self._interval_start_gidx + i
-            matches.extend(oracle.match_pattern(e))
         counters = {
             k: np.asarray(self.state[k])
             for k in (
@@ -300,8 +306,9 @@ class DeviceNFA:
             if int(self.pool["pend_pos"]) > 0:
                 self.pool = self._drain_pend(self.pool)  # reclaim hole pages
             return []
-        # The pend ring is paged with -1 holes; valid ids in [0, pend_pos)
-        # are in emission order (page append order, t-major within a page).
+        # pend_pos is the dense per-key occupancy count: valid ids in
+        # [0, pend_pos) are in emission order, and the only -1 holes are
+        # entries a GC nulled under region overflow (dead chains).
         pos = int(self.pool["pend_pos"])
         pend = np.asarray(self.pool["pend"])[:pos]
         pend = pend[pend >= 0]

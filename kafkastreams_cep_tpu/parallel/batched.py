@@ -77,7 +77,10 @@ class BatchedDeviceNFA:
         engine: str = "auto",
         auto_drain: bool = True,
         exact_replay: bool = True,
+        drain_mode: str = "flat",
     ) -> None:
+        if drain_mode not in ("flat", "pool"):
+            raise ValueError(f"unknown drain_mode {drain_mode!r}")
         if isinstance(stages_or_query, CompiledQuery):
             self.query = stages_or_query
         else:
@@ -151,8 +154,28 @@ class BatchedDeviceNFA:
         self._pos_max_fn = None
         self._drain_compact_fn = None
         self._drain_counts_fn = None
-        self._auto_buffer: Dict[Any, List[Sequence]] = {}
         self._compact_pend_fn = None
+        #: Drain path: "flat" (default) walks every pending chain on device
+        #: into a dense [3, Mb, Cb, K] table (engine.build_chain_flatten)
+        #: so the D2H pull is bounded by match volume; "pool" keeps the
+        #: pinned-closure node-plane pulls as the semantic reference (the
+        #: differential suite pins both paths bitwise-equal).
+        self.drain_mode = drain_mode
+        self._drain_probe_fn = None
+        self._flatten_fns: Dict[Tuple[int, int], Any] = {}
+        #: Overlapped decode: pulled snapshots decode on a single worker
+        #: thread (FIFO -- order across drain boundaries is preserved)
+        #: while the host thread dispatches the next batch; drain() joins.
+        self._decode_pool = None
+        self._decode_futs: List[Any] = []
+        #: D2H accounting for the drain path (bytes actually pulled; the
+        #: flat path's table + probe scale with match volume, not pool
+        #: capacity -- asserted by tests/test_flat_drain.py).
+        self.last_drain_bytes = 0
+        self.drain_pull_bytes = 0
+        #: Region-pressure backoff: set after a region-pressure drain that
+        #: pulled nothing; cleared when a probe next observes a real match.
+        self._region_backoff = False
         self.events_prune_threshold = events_prune_threshold
         self._events: Dict[int, Event] = {}
         self._next_gidx = 0
@@ -441,35 +464,49 @@ class BatchedDeviceNFA:
         """
         T = int(xs["valid"].shape[0])
         step_cap = T * self.config.matches_per_step
-        raw = None
-        # The capacity guard only applies in the paged-append regime
-        # (step_cap <= matches): there the worst-case cursor growth is
-        # exactly one page per matching advance and a pre-advance drain
+        # The capacity guard only applies when a whole per-advance page
+        # fits the ring (step_cap <= matches): there the worst-case cursor
+        # growth is bounded per matching advance and a pre-advance drain
         # makes ring overflow impossible. With step_cap > matches the
         # engine's compact append places what fits and counts the rest in
         # match_drops (loud) -- size EngineConfig.matches to at least one
         # page (T * matches_per_step) for loss-free deferred decode.
         if self.auto_drain and step_cap <= self.config.matches:
-            occ, fill = self._occupancy_bound()
-            if (
-                occ + step_cap > self.config.matches
-                # Region pressure only matters when a drain can reclaim
-                # something: with nothing pending (occ == 0) the fill is
-                # live-lane chains that survive any drain, and firing on
-                # it would put a no-op sync on every advance.
-                or (occ > 0 and fill > (3 * self.config.nodes) // 4)
-            ):
+            occ, fill, probed_pos = self._occupancy_bound()
+            # Region pressure only matters when a drain can reclaim
+            # something. Gate on the freshest PROBED true cursor (> 0 means
+            # real matches were pending at observation time), never on the
+            # worst-case occupancy bound: the bound is nonzero after every
+            # advance since the last probe, so gating on it fires a full
+            # no-op device sync per advance on match-free streams whose
+            # region fill is live-lane chains no drain can reclaim. The
+            # backoff covers the residual race (a probe that aged into a
+            # drain pulling nothing): suppress the region trigger until a
+            # probe next observes a real match.
+            region_pressure = (
+                probed_pos is not None
+                and probed_pos > 0
+                and not self._region_backoff
+                and fill > (3 * self.config.nodes) // 4
+            )
+            if occ + step_cap > self.config.matches or region_pressure:
                 # Real matches approach the ring size (the dense append
                 # keeps occupancy == true count), or undrained pins are
                 # squeezing the node region (3/4-full heuristic; interval
                 # pinning retains everything younger than the oldest
                 # pending root, so a drain is what un-pins): pull pending
-                # matches off the device and clear the ring NOW, but
-                # decode them host-side only after the next advance is
-                # dispatched -- the materialization then overlaps device
-                # compute. Applies to decoding advances too: their own
-                # drain only runs after the advance appended to the ring.
+                # matches off the device and clear the ring NOW. Decode
+                # runs on the worker thread (_submit_decode), overlapping
+                # the D2H wait and materialization with the advance
+                # dispatched below. Applies to decoding advances too:
+                # their own drain only runs after the advance appended to
+                # the ring.
+                ring_full = occ + step_cap > self.config.matches
                 raw = self._pull_raw()
+                if raw is not None:
+                    self._submit_decode(raw)
+                elif region_pressure and not ring_full:
+                    self._region_backoff = True
                 self._pend_accum = 0
         if self._pack_hwms:
             self._processed_gidx = max(
@@ -527,6 +564,7 @@ class BatchedDeviceNFA:
             self._advance = build_batched_advance(self.query, self.config)
             self._post = build_batched_post(self.query, self.config)
             self.state, ys = self._advance(self.state, xs)
+        t_adv = _time.perf_counter()
         self.state, self.pool = self._post(self.state, self.pool, ys)
         self._batches += 1
         self._pend_accum += step_cap
@@ -539,11 +577,9 @@ class BatchedDeviceNFA:
         # device array and break the zero-sync advance path (exact event
         # totals live in the engine's n_events counter).
         self.timings.record_advance(
-            _time.perf_counter() - t0, int(np.prod(xs["valid"].shape))
+            t_adv - t0, int(np.prod(xs["valid"].shape)),
+            post_s=_time.perf_counter() - t_adv,
         )
-        if raw is not None:
-            for k, v in self._decode_raw(raw).items():
-                self._auto_buffer.setdefault(k, []).extend(v)
         out: Dict[Any, List[Sequence]] = {}
         if decode:
             out = self.drain()
@@ -558,13 +594,25 @@ class BatchedDeviceNFA:
 
         t0 = _time.perf_counter()
         self._pend_accum = 0
-        buffered = self._auto_buffer
-        self._auto_buffer = {}
         raw = self._pull_raw()
-        out = buffered
         if raw is not None:
-            for k, v in self._decode_raw(raw).items():
+            self._submit_decode(raw)
+        # Join the decode worker: futures are FIFO (single worker thread),
+        # so matches from earlier auto-drains land before this drain's in
+        # every key's list -- drain boundaries never reorder.
+        out: Dict[Any, List[Sequence]] = {}
+        pull_s = decode_s = 0.0
+        bytes_pulled = 0
+        futs, self._decode_futs = self._decode_futs, []
+        for fut in futs:
+            decoded, meta = fut.result()
+            for k, v in decoded.items():
                 out.setdefault(k, []).extend(v)
+            pull_s += meta.get("pull_s", 0.0)
+            decode_s += meta.get("decode_s", 0.0)
+            bytes_pulled += meta.get("bytes", 0)
+        self.last_drain_bytes = bytes_pulled
+        self.drain_pull_bytes += bytes_pulled
         if self.exact_replay:
             out = self._replay_boundary(out)
         elif bool(self.query.agg_slots) and not self._warned_collisions:
@@ -600,10 +648,13 @@ class BatchedDeviceNFA:
                     RuntimeWarning,
                 )
         # Prune AFTER decoding: the raw snapshot's chains reference events
-        # by gidx, and materialized Sequences hold the Event objects.
+        # by gidx, and materialized Sequences hold the Event objects. The
+        # decode worker is idle here (all futures joined above), so the
+        # registry rebind cannot race an in-flight decode.
         self._prune_events()  # registry must stay bounded on match-free streams
         self.timings.record_drain(
-            _time.perf_counter() - t0, sum(len(v) for v in out.values())
+            _time.perf_counter() - t0, sum(len(v) for v in out.values()),
+            pull_s=pull_s, decode_s=decode_s, bytes_pulled=bytes_pulled,
         )
         return out
 
@@ -652,22 +703,29 @@ class BatchedDeviceNFA:
                         self.query, self.config, sl_state, sl_pool,
                         self._events, ts_base, key,
                     )
+                    matches: List[Sequence] = []
+                    for g_arr, v_arr in self._interval_packs:
+                        if k >= g_arr.shape[1]:
+                            continue  # batch packed before this key was added
+                        for t in range(g_arr.shape[0]):
+                            if v_arr[t, k]:
+                                g = int(g_arr[t, k])
+                                e = self._events[g]
+                                ev_gidx[e] = g
+                                matches.extend(oracle.match_pattern(e))
                 except KeyError as exc:
+                    # Covers both the snapshot rebuild AND the oracle feed
+                    # loop: a registry miss anywhere degrades this key to
+                    # engine-computed matches for the interval -- and fold
+                    # values may diverge from the oracle for it (the same
+                    # caveat as the seq_collisions warning).
                     warnings.warn(
-                        f"exact-replay skipped for key {key!r}: snapshot "
-                        f"event {exc} missing from the registry"
+                        f"exact-replay skipped for key {key!r}: event {exc} "
+                        "missing from the registry (snapshot or oracle "
+                        "feed); this interval's matches are engine-computed "
+                        "and fold values may diverge from the oracle for it"
                     )
                     continue
-                matches: List[Sequence] = []
-                for g_arr, v_arr in self._interval_packs:
-                    if k >= g_arr.shape[1]:
-                        continue  # batch packed before this key was added
-                    for t in range(g_arr.shape[0]):
-                        if v_arr[t, k]:
-                            g = int(g_arr[t, k])
-                            e = self._events[g]
-                            ev_gidx[e] = g
-                            matches.extend(oracle.match_pattern(e))
                 self.replays += 1
                 if matches:
                     out[key] = matches
@@ -797,8 +855,8 @@ class BatchedDeviceNFA:
         bat._next_gidx = r.i64()
         bat._processed_gidx = bat._next_gidx - 1  # no pre-packed xs survive
         # The restored pool may hold pending undrained matches: seed the
-        # capacity guard with the ring cursor (page occupancy, holes
-        # included) so auto-drain cannot undercount after a restore.
+        # capacity guard with the ring cursor (the dense per-key occupancy
+        # count) so auto-drain cannot undercount after a restore.
         bat._pend_accum = int(np.asarray(bat.pool["pend_pos"]).max())
         ts_base = r.i64()
         bat._ts_base = None if ts_base < 0 else ts_base
@@ -847,15 +905,19 @@ class BatchedDeviceNFA:
             pass  # probe still resolves at is_ready()/int() time
         self._pos_probes.append((self._drain_epoch, self._pend_accum, arr))
 
-    def _occupancy_bound(self) -> Tuple[int, int]:
-        """(worst-case ring occupancy, freshest observed region fill).
+    def _occupancy_bound(self) -> Tuple[int, int, Optional[int]]:
+        """(worst-case ring occupancy, freshest observed region fill,
+        freshest probed TRUE cursor -- None while no probe has landed).
 
         Occupancy = the freshest completed cursor probe plus the
         per-advance caps since it (falls back to the pure worst-case
         accumulator while no probe has landed); it grows by at most
         `step_cap` per advance, so adding the caps-since keeps it an
         upper bound. The region fill is the raw observation (a pressure
-        heuristic, not a bound -- node_drops stays the loud backstop)."""
+        heuristic, not a bound -- node_drops stays the loud backstop).
+        The probed cursor gates the region-pressure drain: the dense ring
+        keeps pend_pos == true pending count, so a probed pos > 0 means a
+        drain will actually pull something."""
         while self._pos_probes:
             epoch, acc, arr = self._pos_probes[0]
             try:
@@ -867,10 +929,14 @@ class BatchedDeviceNFA:
             if epoch == self._drain_epoch:
                 vals = np.asarray(arr)
                 self._pos_obs = (acc, int(vals[0]), int(vals[1]))
+                if int(vals[0]) > 0:
+                    # A real match landed: re-arm the region-pressure
+                    # trigger (see advance_packed's backoff).
+                    self._region_backoff = False
         if self._pos_obs is not None:
             acc, pos, fill = self._pos_obs
-            return pos + (self._pend_accum - acc), fill
-        return self._pend_accum, 0
+            return pos + (self._pend_accum - acc), fill, pos
+        return self._pend_accum, 0, None
 
     def _ring_cleared(self) -> None:
         """The pend ring was just drained: invalidate in-flight probes."""
@@ -975,12 +1041,79 @@ class BatchedDeviceNFA:
             self._drain_compact_fn = drain_compact
         return self._drain_compact_fn
 
-    def _pull_raw(self) -> Optional[Dict[str, np.ndarray]]:
-        """Pull pending matches + their chain nodes off the device and
-        clear the ring (a sync point). Decode happens separately
-        (`_decode_raw`) so callers can overlap the Python materialization
-        with the next dispatched batch. Returns None when nothing is
-        pending.
+    def _pull_raw(self) -> Optional[Dict[str, Any]]:
+        """Pull pending matches off the device and clear the ring (a sync
+        point -- the probe; the bulk transfer is asynchronous on the flat
+        path). Decode happens separately (`_decode_raw`, normally on the
+        worker thread via `_submit_decode`) so the D2H wait and the Python
+        materialization overlap the next dispatched batch. Returns None
+        when nothing is pending."""
+        if self.drain_mode == "flat":
+            return self._pull_raw_flat()
+        return self._pull_raw_pool()
+
+    def _pull_raw_flat(self) -> Optional[Dict[str, Any]]:
+        """Chain-flatten drain: ONE fused [3, K] probe (counts, cursors,
+        chain-depth bound -- engine.drain_probe), then one jitted device
+        pass (engine.build_chain_flatten) walks every pending chain into a
+        dense [3, Mb, Cb, K] table whose D2H transfer is started
+        asynchronously. No node-pool plane crosses the tunnel: drain bytes
+        are bounded by true match volume (matches x chain depth), not pool
+        capacity. Mb/Cb are pow2 buckets of the probed per-key maxima, so
+        distinct compiled programs stay O(log M x log B)."""
+        import time as _time
+
+        if self._drain_probe_fn is None:
+            from ..ops.engine import drain_probe
+
+            self._drain_probe_fn = jax.jit(drain_probe)
+        t0 = _time.perf_counter()
+        probe = np.asarray(self._drain_probe_fn(self.pool))  # the one sync
+        counts = probe[0]
+        self.last_match_counts = counts
+        if counts.sum() == 0:
+            if int(probe[1].max()) > 0:
+                self.pool = self._drain_pend(self.pool)  # reclaim cursor
+            self._ring_cleared()
+            return None
+        full_m = self.pool["pend"].shape[0]
+        full_b = self.pool["node_event"].shape[0]
+        Mb = 1
+        while Mb < max(int(counts.max()), 1):
+            Mb <<= 1
+        Mb = min(Mb, full_m)
+        Cb = 1
+        while Cb < max(int(probe[2].max()), 1):
+            Cb <<= 1
+        Cb = min(Cb, full_b)
+        fn = self._flatten_fns.get((Mb, Cb))
+        if fn is None:
+            from ..ops.engine import build_chain_flatten
+
+            fn = self._flatten_fns[(Mb, Cb)] = build_chain_flatten(Mb, Cb)
+        table = fn(self.pool)  # [3, Mb, Cb, K] device-side
+        try:
+            table.copy_to_host_async()
+        except Exception:
+            pass  # transfer still resolves at np.asarray() time
+        raw = {
+            "counts": counts,
+            "table": table,
+            "probe_bytes": int(probe.nbytes),
+            # copy_to_host_async dispatch time: the decode worker's
+            # dispatch->landed wall is the honest transfer upper bound
+            # (PERF.md "Measurement trap": only a forced np.asarray is
+            # trusted on this tunnel).
+            "t_dispatch": _time.perf_counter(),
+            "probe_s": _time.perf_counter() - t0,
+        }
+        self.pool = self._drain_pend(self.pool)
+        self._ring_cleared()
+        return raw
+
+    def _pull_raw_pool(self) -> Optional[Dict[str, Any]]:
+        """Pool-pull drain (the semantic reference path): compact the
+        pend-reachable closure on device and pull its node planes.
 
         Bucketed pulls: nodes are first compacted to pinned-rank space on
         device (`_drain_compact` -- exactly the pend-reachable closure),
@@ -991,6 +1124,9 @@ class BatchedDeviceNFA:
         per-transfer overhead, so both bytes and transfer count are the
         cost (PERF.md "v7").
         """
+        import time as _time
+
+        t0 = _time.perf_counter()
         # One small [2, K] probe decides everything cheap: pending counts
         # and ring cursors.
         if self._drain_counts_fn is None:
@@ -1027,12 +1163,16 @@ class BatchedDeviceNFA:
             Mb <<= 1
         Mb = min(Mb, full_m)
         pulled = np.asarray(nodes3[:, :Bb])            # one [3, Bb, K] pull
+        pend_np = np.asarray(compacted[:Mb])
         raw = {
             "counts": counts,
-            "pend": np.asarray(compacted[:Mb]).T,      # [K, Mb]
+            "pend": pend_np.T,                         # [K, Mb]
             "node_event": pulled[0].T,                 # [K, Bb] closure-rank
             "node_name": pulled[1].T,
             "node_pred": pulled[2].T,
+            # Pool pulls are synchronous: the full wall is the pull time.
+            "pull_s": _time.perf_counter() - t0,
+            "bytes": int(pulled.nbytes + pend_np.nbytes + both.nbytes),
         }
         self.pool = self._drain_pend(self.pool)
         self._ring_cleared()
@@ -1044,12 +1184,55 @@ class BatchedDeviceNFA:
 
         return cached_decoder(self)
 
-    def _decode_raw(self, raw: Dict[str, np.ndarray]) -> Dict[Any, List[Sequence]]:
+    def _submit_decode(self, raw: Dict[str, Any]) -> None:
+        """Queue a pulled snapshot for decode on the worker thread.
+
+        A single worker keeps decode FIFO (matches never reorder across
+        drain boundaries) while the calling thread goes on to dispatch the
+        next batch: the worker blocks on the table's D2H completion and
+        runs the materialization, both overlapped with device compute.
+        The event registry is captured BY REFERENCE here: packs only add
+        keys in place and `_prune_events` rebinds a fresh dict (never
+        mutates the old one), so an in-flight decode always sees every
+        event its chains were built from."""
+        if self._decode_pool is None:
+            import concurrent.futures
+
+            self._decode_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kct-drain-decode"
+            )
+        events = self._events
+        self._decode_futs.append(
+            self._decode_pool.submit(self._decode_job, raw, events)
+        )
+
+    def _decode_job(
+        self, raw: Dict[str, Any], events: Dict[int, Event]
+    ) -> Tuple[Dict[Any, List[Sequence]], Dict[str, Any]]:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        decoded = self._decode_raw(raw, events=events)
+        # The flat path records its own decode_s (net of the D2H wait it
+        # performs in-job); the pool path's pull happened on the calling
+        # thread, so its whole job time is decode.
+        raw.setdefault("decode_s", _time.perf_counter() - t0)
+        return decoded, raw
+
+    def _decode_raw(
+        self,
+        raw: Dict[str, Any],
+        events: Optional[Dict[int, Event]] = None,
+    ) -> Dict[Any, List[Sequence]]:
         """Materialize a pulled snapshot into per-key Sequence lists.
 
         The C decoder (native/decoder.cc) walks every chain and builds the
         Sequence objects in one call (~30 us -> ~2 us per match); the numpy
         + Python path below is the fallback and the semantic reference."""
+        if events is None:
+            events = self._events
+        if "table" in raw:
+            return self._decode_flat(raw, events)
         qid_tab = self.query.qid_of_name_id
         native = self._native_decoder()
         if native is not None:
@@ -1062,7 +1245,7 @@ class BatchedDeviceNFA:
                 raw["node_name"],
                 raw["node_pred"],
                 self.query.name_of_id,
-                self._events,
+                events,
                 Staged,
                 Sequence,
                 None if qid_tab is None else np.ascontiguousarray(qid_tab, np.int32),
@@ -1103,12 +1286,87 @@ class BatchedDeviceNFA:
             if not chain:
                 continue  # GC-dropped under overflow (node_drops counts it)
             key = self.keys[k_idx]
-            seq = materialize_sequence(chain, self.query.name_of_id, self._events)
+            seq = materialize_sequence(chain, self.query.name_of_id, events)
             if qid_tab is not None:
                 # Stacked-query attribution: chains never span queries.
                 out.setdefault(key, []).append((int(qid_tab[chain[0][0]]), seq))
             else:
                 out.setdefault(key, []).append(seq)
+        return out
+
+    def _decode_flat(
+        self, raw: Dict[str, Any], events: Dict[int, Event]
+    ) -> Dict[Any, List[Sequence]]:
+        """Decode a chain-flattened drain table (the walk already happened
+        on device -- engine.build_chain_flatten): a flat loop over
+        [match, hop] rows, no pointer chasing. The C fast path
+        (native/decoder.cc decode_matches_flat) and this numpy + Python
+        fallback share semantics with the pool-walk decode bit for bit:
+        hops are newest-first, gidx < 0 hops (GC-dropped puts) are skipped
+        while the chain continues, and all-dead chains decode to nothing
+        (node_drops counts them)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        table = np.asarray(raw["table"])  # blocks until the D2H landed
+        t_land = _time.perf_counter()
+        raw["pull_s"] = t_land - raw.get("t_dispatch", t0)
+        raw["bytes"] = int(table.nbytes) + raw.get("probe_bytes", 0)
+        counts = np.ascontiguousarray(raw["counts"], np.int32)
+        # [3, Mb, Cb, K] -> per-plane [K, Mb, Cb] strided views (no copy).
+        gidx = np.moveaxis(table[0], -1, 0)
+        name = np.moveaxis(table[1], -1, 0)
+        live = np.moveaxis(table[2], -1, 0)
+        qid_tab = self.query.qid_of_name_id
+        native = self._native_decoder()
+        if native is not None and hasattr(native, "decode_matches_flat"):
+            from ..core.sequence import Staged
+
+            per_key = native.decode_matches_flat(
+                counts,
+                gidx,
+                name,
+                live,
+                self.query.name_of_id,
+                events,
+                Staged,
+                Sequence,
+                None if qid_tab is None else np.ascontiguousarray(qid_tab, np.int32),
+            )
+            out = {
+                self.keys[k]: seqs
+                for k, seqs in enumerate(per_key)
+                if seqs
+            }
+            raw["decode_s"] = _time.perf_counter() - t_land
+            return out
+        K, Mb, Cb = gidx.shape
+        out: Dict[Any, List[Sequence]] = {}
+        for k in range(min(K, len(self.keys))):
+            n = min(int(counts[k]), Mb)
+            seqs: List[Any] = []
+            for j in range(n):
+                chain: List[Tuple[int, int]] = []
+                for c in range(Cb):
+                    if not live[k, j, c]:
+                        break
+                    g = int(gidx[k, j, c])
+                    if g >= 0:
+                        chain.append((int(name[k, j, c]), g))
+                if not chain:
+                    continue  # GC-dropped under overflow (node_drops)
+                chain.reverse()  # newest-first walk -> oldest-first decode
+                seq = materialize_sequence(
+                    chain, self.query.name_of_id, events
+                )
+                if qid_tab is not None:
+                    # Stacked-query attribution: chains never span queries.
+                    seqs.append((int(qid_tab[chain[0][0]]), seq))
+                else:
+                    seqs.append(seq)
+            if seqs:
+                out[self.keys[k]] = seqs
+        raw["decode_s"] = _time.perf_counter() - t_land
         return out
 
     def _prune_events(self) -> None:

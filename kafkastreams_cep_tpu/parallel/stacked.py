@@ -42,6 +42,7 @@ class StackedQueryEngine:
         mesh: Optional[Any] = None,
         engine: str = "auto",
         auto_drain: bool = True,
+        drain_mode: str = "flat",
     ) -> None:
         self.query = compile_multi_query(named_queries, schema)
         self.query_names: List[str] = list(self.query.query_names or [])
@@ -52,6 +53,7 @@ class StackedQueryEngine:
             mesh=mesh,
             engine=engine,
             auto_drain=auto_drain,
+            drain_mode=drain_mode,
         )
 
     # ------------------------------------------------------------------ API

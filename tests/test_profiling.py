@@ -30,6 +30,24 @@ def test_batch_timings_summary_and_histogram():
     assert t.summary()["batches"] <= 4
 
 
+def test_batch_timings_components_and_tunnel_rate():
+    """The per-component breakdown: {advance, post, drain_pull, decode} ms
+    means plus tunnel_mbps = pulled bytes / D2H wall."""
+    t = BatchTimings()
+    t.record_advance(0.010, 64, post_s=0.004)
+    t.record_drain(0.020, 5, pull_s=0.010, decode_s=0.006,
+                   bytes_pulled=1_000_000)
+    c = t.components()
+    assert c["advance_ms"] == 10.0
+    assert c["post_ms"] == 4.0
+    assert c["drain_pull_ms"] == 10.0
+    assert c["decode_ms"] == 6.0
+    assert c["drain_bytes"] == 1_000_000
+    assert abs(c["tunnel_mbps"] - 100.0) < 1e-6  # 1 MB / 10 ms
+    # No pull observed -> no rate claimed (None, not 0 or inf).
+    assert BatchTimings().components()["tunnel_mbps"] is None
+
+
 def test_engine_records_timings():
     pattern = (
         QueryBuilder()
@@ -49,3 +67,10 @@ def test_engine_records_timings():
     assert s["batches"] == 1 and s["drains"] == 1 and s["matches"] == 1
     assert bat.timings.histogram()["n"] == 1
     assert s["emit_latency_ms_p50"] > 0
+    # A match-bearing drain populates the component breakdown and the
+    # D2H accounting (the flat path's table + probe bytes).
+    c = bat.timings.components()
+    assert c["advance_ms"] > 0
+    assert c["drain_pull_ms"] > 0 and c["drain_bytes"] > 0
+    assert c["tunnel_mbps"] is None or c["tunnel_mbps"] > 0
+    assert bat.drain_pull_bytes > 0
