@@ -111,6 +111,14 @@ from kafkastreams_cep_tpu.pattern.expressions import agg, field, value  # noqa: 
 
 TS0 = 1_000_000
 
+#: Tunnel-health floor for the bench-integrity flag: BENCH_r05's artifact
+#: was produced over a degraded ~10 MB/s axon tunnel and read as a 12x
+#: regression until VERDICT r5 diagnosed the environment (§weak-1). A
+#: healthy chip link moves well above this; below it the JSON flags
+#: itself `tunnel_degraded` so the artifact self-describes. CPU runs are
+#: exempt (no tunnel; tiny pulls make MB/s meaningless there).
+TUNNEL_FLOOR_MBPS = 50.0
+
 
 def log(msg: str) -> None:
     print(f"[bench +{time.perf_counter() - _T_START:7.1f}s] {msg}", file=sys.stderr, flush=True)
@@ -451,20 +459,40 @@ def bench_device_batched(
 def bench_device_latency(
     pattern_fn: Callable, schema_fn, stream_fn: Callable,
     config: EngineConfig, n_keys: int, batch: int, n_batches: int,
+    target_emit_ms: float = None,
+    pipelined: bool = False,
+    profile_sync: bool = False,
 ) -> Dict[str, Any]:
     """Latency-frontier run: small batches, decode + block on every one.
 
     Every batch is a drain, so BatchTimings' emit latency (advance dispatch
     -> drain return) is the p99 an outside observer sees per micro-batch.
+    With `config.gc_group` > 1 the per-batch drains ride the flush-free
+    region++window view (skip_any8 carries no folds, so exact replay is
+    disarmed and never forces the flush), so the mark/sweep that used to
+    dominate every micro-batch is paid once per G advances -- the 500 ms
+    match-emit contract's lever.
+
+    `pipelined=True` instead drives the production micro-drain shape: the
+    timed loop never drains -- `advance_packed`'s target_emit_ms hook
+    pulls the ring itself (flush-free) and decodes on the worker thread;
+    the terminal drain only joins futures. No per-drain block means no
+    per-batch emit samples, so this mode is for exercising/timing the
+    pipelined path, not for percentile claims.
     """
     schema = schema_fn() if schema_fn else None
     query = compile_query(compile_pattern(pattern_fn()), schema)
     bat = BatchedDeviceNFA(
         query, keys=[f"k{i}" for i in range(n_keys)], config=config,
-        engine=ARGS.engine,
+        engine=ARGS.engine, target_emit_ms=target_emit_ms,
+        profile_sync=profile_sync,
     )
     rng = random.Random(23)
-    n_warm = 3
+    # Warmup must cover a FULL GC-group cycle plus the group-boundary
+    # drain: the flush program and both drain-probe shapes (padded
+    # window view + bare pool) compile lazily, and a compile landing in
+    # the timed loop swamps the percentiles (and the sweep's post_ms).
+    n_warm = max(3, bat.gc_group + 1)
     streams = {
         k: stream_fn(rng, batch * (n_batches + n_warm)) for k in bat.keys
     }
@@ -482,9 +510,14 @@ def bench_device_latency(
     bat.timings = BatchTimings()
     t0 = time.perf_counter()
     n_matches = 0
-    for xs in packed[n_warm:]:
-        out = bat.advance_packed(xs, decode=True)
-        n_matches += sum(len(v) for v in out.values())
+    if pipelined:
+        for xs in packed[n_warm:]:
+            bat.advance_packed(xs, decode=False)
+        n_matches = sum(len(v) for v in bat.drain().values())
+    else:
+        for xs in packed[n_warm:]:
+            out = bat.advance_packed(xs, decode=True)
+            n_matches += sum(len(v) for v in out.values())
     dt = time.perf_counter() - t0
     summary = bat.timings.summary()
     stats = bat.stats
@@ -493,6 +526,9 @@ def bench_device_latency(
     return dict(
         events=n, seconds=dt, eps=n / dt, matches=n_matches,
         keys=n_keys, batch=batch, engine=bat.engine,
+        gc_group=bat.gc_group, flushes=bat.flushes,
+        target_emit_ms=target_emit_ms, pipelined=pipelined,
+        drain_pull_bytes=int(bat.drain_pull_bytes),
         p50_match_emit_ms=summary.get("emit_latency_ms_p50"),
         p99_match_emit_ms=summary.get("emit_latency_ms_p99"),
         components=components,
@@ -659,19 +695,79 @@ def main() -> None:
         # Latency frontier: small per-drain batches (BASELINE.md names p99
         # match-emit latency a co-equal metric). T=8 with a decode+block
         # every batch trades throughput for a ~two-orders-lower p99 than
-        # the throughput config's deferred drains.
-        log("skip_any8_latency (T=8, per-batch drain)")
+        # the throughput config's deferred drains. gc_group=8 is the PR 4
+        # lever: the flush-free flat drain reads the region++window view
+        # (skip_any8 has no folds, so exact replay never forces the
+        # flush), so the mark/sweep that used to run -- and dominate --
+        # every micro-batch is paid once per 8 advances. (target_emit_ms
+        # is NOT set here: the hook only fires on non-decoding advances,
+        # and this pass decodes+blocks every batch -- the pipelined
+        # micro-drain shape is the smoke's _microdrain pass below.)
+        # nodes=3072 (up from the throughput
+        # config's 2048-at-T=8 needs): up to G advances' window nodes stay
+        # resident between flushes (the G-vs-pool-headroom trade, PERF.md
+        # v9), so the region must absorb a whole group's fold-back --
+        # sized for ZERO drop counters at this shape.
+        log("skip_any8_latency (T=8, per-batch drain, gc_group=8)")
         lat_keys = ARGS.keys or (8 if quick else 2048)
         lat_T = 4 if quick else 8
         lat_nb = 4 if quick else 24
         lat = bench_device_latency(
             skip_any8_pattern, None, skip_any8_stream,
-            EngineConfig(lanes=288, nodes=2048, matches=2048,
+            EngineConfig(lanes=288, nodes=3072, matches=2048,
                          matches_per_step=64, nodes_per_step=64,
-                         strict_windows=True, pin_interval=True),
+                         strict_windows=True, pin_interval=True,
+                         gc_group=8),
             lat_keys, lat_T, lat_nb,
         )
         detail["skip_any8_latency"] = lat
+        if ARGS.smoke:
+            # CI-sized config for the two smoke-only passes below: they
+            # check the micro-drain CODE PATH and the GC-group CADENCE,
+            # not the flagship sizing, and the flagship planes make the
+            # drain-probe/flush compiles the whole wall on a 2-core CI
+            # box.
+            def _ci_cfg(g: int) -> EngineConfig:
+                return EngineConfig(lanes=32, nodes=512, matches=512,
+                                    matches_per_step=16, nodes_per_step=16,
+                                    strict_windows=True, pin_interval=True,
+                                    gc_group=g)
+
+            # Micro-drain CI pass (satellite: the emit-latency path must
+            # not be hardware-only): pipelined dispatch with NO caller
+            # drains in the timed loop -- target_emit_ms=0 makes
+            # advance_packed's own micro-drain hook pull the ring every
+            # advance through the flush-free window view and decode on
+            # the worker thread; the terminal drain only joins futures.
+            log("skip_any8_latency_microdrain (pipelined, target_emit_ms=0)")
+            detail["skip_any8_latency_microdrain"] = bench_device_latency(
+                skip_any8_pattern, None, skip_any8_stream,
+                _ci_cfg(4), lat_keys, lat_T, lat_nb,
+                target_emit_ms=0.0, pipelined=True,
+            )
+            # GC-group amortization contract on CPU: post COMPUTE
+            # ms/advance (profile_sync blocks after the post section;
+            # dispatch walls are ~constant in G) must strictly fall as G
+            # rises at fixed T -- the flush runs 1/G as often; the
+            # per-advance append is G-invariant. Tiny sizes: the sweep
+            # checks monotonicity, not absolute numbers.
+            sweep: Dict[str, Any] = {"T": lat_T, "post_ms": {}}
+            for g in (1, 2, 4):
+                r = bench_device_latency(
+                    skip_any8_pattern, None, skip_any8_stream,
+                    _ci_cfg(g), lat_keys, lat_T, 12,
+                    profile_sync=True,
+                )
+                sweep["post_ms"][str(g)] = r["components"]["post_ms"]
+            posts = [sweep["post_ms"][str(g)] for g in (1, 2, 4)]
+            sweep["monotone_decreasing"] = bool(
+                all(a > b for a, b in zip(posts, posts[1:]))
+            )
+            detail["gc_group_sweep"] = sweep
+            log(
+                f"gc_group_sweep post_ms/advance {sweep['post_ms']} "
+                f"monotone={sweep['monotone_decreasing']}"
+            )
         # Config 4: N concurrent queries over one stream.
         log("multi_query (config 4)")
         detail["multi_query"] = bench_multi_query(
@@ -684,6 +780,24 @@ def main() -> None:
     # The reference-contract denominator: per-record processing with the
     # reference's every-record snapshot serialization.
     denom = detail.get("skip_any8", {}).get("host", {}).get("serde_eps", 0.0)
+    # Bench integrity: an environment-degraded artifact must self-describe
+    # (BENCH_r05 shipped over a ~10 MB/s tunnel and read as a 12x drain
+    # regression until VERDICT r5 diagnosed the link, §weak-1). CPU runs
+    # are exempt: there is no tunnel, and smoke-size pulls make MB/s
+    # meaningless.
+    tunnel = detail.get("skip_any8_batched", {}).get("tunnel_mbps")
+    tunnel_degraded = bool(
+        platform != "cpu"
+        and tunnel is not None
+        and tunnel < TUNNEL_FLOOR_MBPS
+    )
+    if tunnel_degraded:
+        log(
+            f"WARNING: tunnel_mbps {tunnel:.1f} is below the "
+            f"{TUNNEL_FLOOR_MBPS:.0f} MB/s health floor -- the D2H link is "
+            "degraded; drain-side figures in this artifact understate the "
+            "engine and MUST NOT be read as regressions"
+        )
     out = {
         "metric": "events_per_sec_skip_any8_batched",
         "value": round(headline, 1),
@@ -697,7 +811,13 @@ def main() -> None:
         # tunnel rate measured by the drain's forced np.asarray (PERF.md
         # "Measurement trap": block_until_ready is not trusted here).
         "components": detail.get("skip_any8_batched", {}).get("components"),
-        "tunnel_mbps": detail.get("skip_any8_batched", {}).get("tunnel_mbps"),
+        "tunnel_mbps": tunnel,
+        "tunnel_degraded": tunnel_degraded,
+        # The 500 ms match-emit contract's metric, from the retuned
+        # latency config (T=8, gc_group=8, per-batch flush-free drains).
+        "latency_p99_match_emit_ms": detail.get("skip_any8_latency", {}).get(
+            "p99_match_emit_ms"
+        ),
         "platform": platform,
         "quick": quick,
         # No JVM is provisionable in this zero-egress image: the baseline

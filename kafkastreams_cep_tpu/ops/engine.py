@@ -115,6 +115,18 @@ class EngineConfig:
     #: precise walks or size `nodes` for the interval's put volume.
     #: node_drops stays the loud overflow signal either way.
     pin_interval: bool = False
+    #: GC group size G: the full mark/sweep + compaction folds the
+    #: accumulated time-indexed append window back into the region only on
+    #: every G-th advance, dividing the post pass's page-root/lane walks
+    #: and the sweep argsort by G (the pend append stays per-advance, so
+    #: capacity guards keep observing true match counts). The engine
+    #: state's `gc_phase` scalar tracks the group's step offset; drains,
+    #: checkpoints and region-pressure triggers force an early group
+    #: flush, so G only changes WHEN garbage is collected, never what.
+    #: The trade: up to G advances' window nodes stay resident between
+    #: flushes, so size `nodes` for the group's retention (PERF.md v9
+    #: "GC groups"). G=1 is the classic every-advance GC.
+    gc_group: int = 1
 
     def dewey_width(self, query: CompiledQuery) -> int:
         return self.digits if self.digits > 0 else query.n_stages + 2
@@ -162,6 +174,13 @@ def init_state(query: CompiledQuery, config: EngineConfig) -> Dict[str, jnp.ndar
         "regs": np.zeros((R, A), np.float32),  # fold registers (per lane)
         "regs_set": np.zeros((R, A), bool),
         "runs": np.asarray(len(begins), np.int32),  # global run counter
+        #: group-phase scalar (EngineConfig.gc_group): the number of event
+        #: steps already written into the current group's time-indexed
+        #: append window. The advance offsets fresh node ids by
+        #: `gc_phase * nodes_per_step`-per-step past `nodes`; the flush
+        #: (full mark/sweep) resets it to 0. Always 0 at drain/checkpoint
+        #: boundaries (early group flush).
+        "gc_phase": np.asarray(0, np.int32),
         # -- observability counters (SURVEY.md section 5.1/5.5) --------------
         "n_events": np.asarray(0, np.int32),
         "n_branches": np.asarray(0, np.int32),
@@ -759,6 +778,7 @@ def build_step(
             "branching": n_br, "ignored": n_ig,
             "regs": n_regs, "regs_set": n_regs_set,
             "runs": new_runs,
+            "gc_phase": state["gc_phase"],  # advanced by the post pass only
             "n_events": state["n_events"] + 1,
             "n_branches": state["n_branches"]
             + jnp.sum(jnp.stack([u["clone_m"] for u in up if u is not None])).astype(jnp.int32),
@@ -1165,20 +1185,93 @@ def remap_pend_blocks(
     return out
 
 
-def build_post(query: CompiledQuery, config: EngineConfig):
-    """Single-key post pass: pend-page append + pin-seeded mark-sweep GC."""
+#: The ys node planes a GC group's accumulated window carries between the
+#: per-advance append and the group flush (the match planes are consumed
+#: by the append itself every advance).
+WINDOW_PLANES = ("w_event", "w_name", "w_pred")
+
+
+def concat_group_window(
+    group_ys: List[Dict[str, jnp.ndarray]],
+    group_roots: List[jnp.ndarray],
+) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+    """Concatenate a GC group's accumulated per-advance window segments
+    (ys node planes along the step axis; page roots likewise) into the
+    single window the group flush folds back. Single-segment groups pass
+    through concat-free. Shared by the single-key and batched drivers --
+    the flush semantics must never diverge between them (the differential
+    suite uses the single-key engine as reference)."""
+    if len(group_ys) == 1:
+        return group_ys[0], group_roots[0]
+    ys_cat = {
+        k: jnp.concatenate([ys[k] for ys in group_ys], axis=0)
+        for k in WINDOW_PLANES
+    }
+    return ys_cat, jnp.concatenate(group_roots, axis=0)
+
+
+def build_append_post(config: EngineConfig):
+    """Single-key per-advance light post: pend-page append + group-phase
+    bump. Runs EVERY advance (capacity guards keep observing true pending
+    counts); the mark/sweep GC is deferred to the group flush
+    (build_flush_post). Returns (state', pool', page_roots) -- the caller
+    accumulates page_roots (and the ys node planes) until the flush."""
     append = build_pend_append(config)
+
+    def post_append(
+        state: Dict[str, jnp.ndarray],
+        pool: Dict[str, jnp.ndarray],
+        ys: Dict[str, jnp.ndarray],
+    ):
+        state, pool, page_roots = append(
+            state, pool, ys["w_match"], ys["w_mroot"]
+        )
+        state = {
+            **state,
+            "gc_phase": (
+                state["gc_phase"]
+                + jnp.int32(ys["w_event"].shape[0])
+            ).astype(jnp.int32),
+        }
+        return state, pool, page_roots
+
+    return post_append
+
+
+def build_flush_post(query: CompiledQuery, config: EngineConfig):
+    """Single-key group flush: pin-seeded mark/sweep + compaction over the
+    group's ACCUMULATED time-indexed window (ys node planes concatenated
+    along the step axis; page_roots likewise), then reset `gc_phase`.
+    With gc_group=1 this is exactly the classic per-advance GC."""
     gc = build_gc(query, config)
+
+    def flush(
+        state: Dict[str, jnp.ndarray],
+        pool: Dict[str, jnp.ndarray],
+        ys: Dict[str, jnp.ndarray],
+        page_roots: jnp.ndarray,
+    ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        state, pool = gc(state, pool, ys, page_roots)
+        state = {**state, "gc_phase": jnp.zeros_like(state["gc_phase"])}
+        return state, pool
+
+    return flush
+
+
+def build_post(query: CompiledQuery, config: EngineConfig):
+    """Single-key every-advance post pass (append + GC fused in one jit):
+    the G=1 composition kept for tests and one-shot callers; the drivers
+    run build_append_post/build_flush_post at the group cadence."""
+    append = build_append_post(config)
+    flush = build_flush_post(query, config)
 
     def post(
         state: Dict[str, jnp.ndarray],
         pool: Dict[str, jnp.ndarray],
         ys: Dict[str, jnp.ndarray],
     ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
-        state, pool, page_roots = append(
-            state, pool, ys["w_match"], ys["w_mroot"]
-        )
-        return gc(state, pool, ys, page_roots)
+        state, pool, page_roots = append(state, pool, ys)
+        return flush(state, pool, ys, page_roots)
 
     return post
 
@@ -1325,7 +1418,11 @@ def build_batch_fn(query: CompiledQuery, config: EngineConfig):
     `xs` is the packed batch: event columns ("f:*", "ts", "topic") of shape
     [T], plus "spred" [T, P] (precomputed stateless predicate rows),
     "gidx" [T] global event indices and "valid" [T]. Returns the new state
-    and ys, the stacked per-step node/match outputs consumed by build_post.
+    and ys, the stacked per-step node/match outputs consumed by the post
+    pass (build_append_post per advance + build_flush_post at group
+    boundaries). The step index is offset by the state's `gc_phase` group
+    scalar so each advance of a GC group writes its node emissions into its
+    own segment of the accumulated time-indexed window.
     """
     step = build_step(query, config)
 
@@ -1338,7 +1435,8 @@ def build_batch_fn(query: CompiledQuery, config: EngineConfig):
             return step(carry, x, t)
 
         state, ys = jax.lax.scan(
-            body, state, (xs, jnp.arange(T, dtype=jnp.int32))
+            body, state,
+            (xs, state["gc_phase"] + jnp.arange(T, dtype=jnp.int32)),
         )
         return state, ys
 

@@ -232,9 +232,13 @@ def build_pallas_batched_advance(
         name for name, dt in query.schema.fields.items()
         if np.dtype(dt) == np.dtype(np.float32)
     ]
-    # xi column order: ts, topic, gidx, valid, ints..., spred...
+    # xi column order: ts, topic, gidx, valid, ints..., spred..., gc_phase
+    # (the group's step offset -- rides the event columns so the kernel
+    # needs no extra input ref; every row of a batch carries the same
+    # value, read per key block as an (8, 1) scalar plane).
     XI_BASE = 4
-    CI = XI_BASE + len(int_fields) + P
+    PH_COL = XI_BASE + len(int_fields) + P
+    CI = PH_COL + 1
     CF = len(f32_fields)
 
     # Per-lane stage lookups are unrolled selects over the static stage
@@ -558,7 +562,11 @@ def build_pallas_batched_advance(
             partial = partial + m.astype(jnp.int32)
         n_put = jnp.sum(put_cnt, axis=1, keepdims=True)  # (8, 1)
 
-        base = B + t * P_CAP  # window base for this step's node ids
+        # Window base for this step's node ids: the group-phase step offset
+        # (an (8, 1) plane from xi; identical across keys) shifts this
+        # advance's segment past earlier advances' in the accumulated
+        # group window (EngineConfig.gc_group).
+        base = B + (xi[:, PH_COL : PH_COL + 1] + t) * P_CAP
         put_idx = [
             jnp.where(
                 put_masks[l] & (put_ranks[l] < P_CAP),
@@ -889,17 +897,25 @@ def build_pallas_batched_advance(
         wmt_o[0] = w_match
         wmr_o[0] = w_mroot
 
+    G = max(int(config.gc_group), 1)
+
     def advance_impl(state, xs):
         T, K = xs["valid"].shape
         if K % 8 != 0:
             raise ValueError(f"pallas advance needs K % 8 == 0, got {K}")
-        if B + T * P_CAP >= (1 << 24):
+        if B + G * T * P_CAP >= (1 << 24):
             raise ValueError(
-                "node-id window exceeds f32-exact range; shrink the batch "
-                f"or nodes_per_step (B={B}, T={T}, cap={P_CAP})"
+                "node-id window exceeds f32-exact range; shrink the batch, "
+                f"nodes_per_step or gc_group (B={B}, T={T}, cap={P_CAP}, "
+                f"G={G})"
             )
         # -- pack xi [T, K, CI] / xf [T, K, max(CF,1)] -----------------------
         spred = xs["spred"]  # [T, K, P]
+        # Group-phase step offset: replicated into every (t, k) slot (the
+        # drivers keep all keys' phases in lockstep).
+        phase = jnp.broadcast_to(
+            state["gc_phase"].astype(jnp.int32)[None, :], (T, K)
+        )
         xi_cols = [
             xs["ts"].astype(jnp.int32),
             xs["topic"].astype(jnp.int32),
@@ -908,7 +924,9 @@ def build_pallas_batched_advance(
         ]
         xi_cols += [xs[f"f:{n}"].astype(jnp.int32) for n in int_fields]
         xi = jnp.concatenate(
-            [c[:, :, None] for c in xi_cols] + [spred.astype(jnp.int32)], axis=2
+            [c[:, :, None] for c in xi_cols]
+            + [spred.astype(jnp.int32), phase[:, :, None]],
+            axis=2,
         )
         if CF:
             xf = jnp.stack([xs[f"f:{n}"] for n in f32_fields], axis=2)
@@ -1022,13 +1040,131 @@ def build_pallas_batched_advance(
     return advance_sharded
 
 
+def build_pallas_batched_append(
+    config: EngineConfig,
+    mesh: Optional[Any] = None,
+):
+    """Per-advance light post (dense scatter-append + group-phase bump) for
+    pallas-layout ys ([T, K, cap]). The mark/sweep GC is deferred to the
+    group flush (build_pallas_batched_flush); the append stays per-advance
+    so capacity guards keep observing true pending counts.
+
+    With `mesh`, runs under `shard_map` over the key axis like the advance
+    (the append offset is per-key; no collectives)."""
+    from .engine import build_pend_append
+
+    append = build_pend_append(config)
+
+    def append_impl(state, pool, ys):
+        # w_match arrives [T, K, M_STEP]; the append wants the key axis
+        # last ([T, M_STEP, K]) so its page reshape stays t-major.
+        state, pool, page_roots = append(
+            state,
+            pool,
+            jnp.transpose(ys["w_match"], (0, 2, 1)),
+            jnp.transpose(ys["w_mroot"], (0, 2, 1)),
+        )
+        state = {
+            **state,
+            "gc_phase": (
+                state["gc_phase"] + jnp.int32(ys["w_event"].shape[0])
+            ).astype(jnp.int32),
+        }
+        return state, pool, page_roots
+
+    if mesh is None:
+        return jax.jit(append_impl)
+
+    from jax.experimental.shard_map import shard_map
+
+    @jax.jit
+    def append_sharded(state, pool, ys):
+        state_spec = jax.tree.map(
+            lambda l: _key_axis_spec(l, l.ndim - 1), state
+        )
+        pool_spec = jax.tree.map(
+            lambda l: _key_axis_spec(l, l.ndim - 1), pool
+        )
+        ys_spec = jax.tree.map(lambda l: _key_axis_spec(l, 1), ys)
+        roots_spec = _key_axis_spec(jnp.zeros((1, 1)), 1)
+        return shard_map(
+            append_impl,
+            mesh=mesh,
+            in_specs=(state_spec, pool_spec, ys_spec),
+            out_specs=(state_spec, pool_spec, roots_spec),
+            check_rep=False,
+        )(state, pool, ys)
+
+    return append_sharded
+
+
+def build_pallas_batched_flush(
+    query: CompiledQuery,
+    config: EngineConfig,
+    mesh: Optional[Any] = None,
+):
+    """Group flush (pin-seeded mark/sweep + compaction) for pallas-layout
+    ys node planes concatenated over the group's advances ([T_group, K,
+    cap]; page_roots [TM_group, K]). Resets the group-phase scalar. The
+    ring remap runs as a dynamic block loop over the occupied prefix
+    (engine.remap_pend_blocks).
+
+    With `mesh`, runs under `shard_map` over the key axis like the
+    advance (the GC is per-key; no collectives)."""
+    from .engine import build_gc, remap_pend_blocks
+
+    gc = jax.vmap(
+        build_gc(query, config, defer_pend_remap=True),
+        in_axes=(-1, -1, 1, -1), out_axes=(-1, -1, -1),
+    )
+
+    def flush_impl(state, pool, ys, page_roots):
+        state, pool, remap_full = gc(state, pool, ys, page_roots)
+        pool = {
+            **pool,
+            "pend": remap_pend_blocks(
+                pool["pend"], remap_full, pool["pend_pos"]
+            ),
+        }
+        state = {**state, "gc_phase": jnp.zeros_like(state["gc_phase"])}
+        return state, pool
+
+    if mesh is None:
+        return jax.jit(flush_impl)
+
+    from jax.experimental.shard_map import shard_map
+
+    @jax.jit
+    def flush_sharded(state, pool, ys, page_roots):
+        state_spec = jax.tree.map(
+            lambda l: _key_axis_spec(l, l.ndim - 1), state
+        )
+        pool_spec = jax.tree.map(
+            lambda l: _key_axis_spec(l, l.ndim - 1), pool
+        )
+        ys_spec = jax.tree.map(lambda l: _key_axis_spec(l, 1), ys)
+        roots_spec = _key_axis_spec(page_roots, 1)
+        return shard_map(
+            flush_impl,
+            mesh=mesh,
+            in_specs=(state_spec, pool_spec, ys_spec, roots_spec),
+            out_specs=(state_spec, pool_spec),
+            check_rep=False,
+        )(state, pool, ys, page_roots)
+
+    return flush_sharded
+
+
 def build_pallas_batched_post(
     query: CompiledQuery,
     config: EngineConfig,
     mesh: Optional[Any] = None,
 ):
-    """Post pass (dense scatter-append + GC) for pallas-layout ys
-    ([T, K, cap]).
+    """Every-advance post pass (dense scatter-append + GC) for pallas-layout
+    ys ([T, K, cap]): the G=1 composition kept for tests and one-shot
+    callers; the batched driver runs build_pallas_batched_append/
+    build_pallas_batched_flush at the group cadence
+    (EngineConfig.gc_group).
 
     With `mesh`, runs under `shard_map` over the key axis like the advance
     (the append offset and GC are per-key; no collectives). The ring

@@ -287,4 +287,7 @@ def oracle_to_device(
         "lane_drops", "node_drops", "match_drops", "seq_collisions",
     ):
         state[ctr] = np.asarray(old_state[ctr], np.int32)
+    # Resyncs happen at drain boundaries, after the group flush: the
+    # group-phase scalar is 0 there (the renumbered pool has no window).
+    state["gc_phase"] = np.asarray(0, np.int32)
     return state, pool

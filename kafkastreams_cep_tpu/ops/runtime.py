@@ -30,9 +30,12 @@ from ..pattern.stages import Stages
 import jax
 
 from .engine import (
+    WINDOW_PLANES,
     EngineConfig,
+    build_append_post,
     build_batch_fn,
-    build_post,
+    build_flush_post,
+    concat_group_window,
     drain_pend,
     eval_stateless_preds,
     init_pool,
@@ -68,10 +71,17 @@ class DeviceNFA:
             self.query = compile_query(stages_or_query, schema)
         self.config = config if config is not None else EngineConfig()
         self._advance = build_batch_fn(self.query, self.config)
-        self._post = jax.jit(build_post(self.query, self.config))
+        self._append_post = jax.jit(build_append_post(self.config))
+        self._flush_post = jax.jit(build_flush_post(self.query, self.config))
+        # GC groups (EngineConfig.gc_group): the pend append runs every
+        # advance, the mark/sweep GC only on the G-th -- node ids are
+        # region-stable only through the flush's remap, so drains,
+        # checkpoints and pool introspection force an early group flush.
+        self.gc_group = max(int(self.config.gc_group), 1)
+        self._group_ys: List[Dict[str, jnp.ndarray]] = []
+        self._group_roots: List[jnp.ndarray] = []
+        self.flushes = 0
         self._drain_pend = jax.jit(drain_pend)
-        # The post pass (pend-append + GC) runs every advance by design:
-        # node ids are only stable across advances through its remap.
         self.events_prune_threshold = events_prune_threshold
         self.state = init_state(self.query, self.config)
         self.pool = init_pool(self.query, self.config)
@@ -123,6 +133,7 @@ class DeviceNFA:
         The device analog of inspecting NFA.computation_stages in tests
         (reference: NFATest.assertNFA, NFATest.java:836-840).
         """
+        self._flush_group()  # lane nodes may point into the group window
         active = np.asarray(self.state["active"])
         src = np.asarray(self.state["src"])
         seq = np.asarray(self.state["seq"])
@@ -159,7 +170,13 @@ class DeviceNFA:
             return []
         xs = self._pack(events)
         self.state, ys = self._advance(self.state, xs)
-        self.state, self.pool = self._post(self.state, self.pool, ys)
+        self.state, self.pool, page_roots = self._append_post(
+            self.state, self.pool, ys
+        )
+        self._group_ys.append({k: ys[k] for k in WINDOW_PLANES})
+        self._group_roots.append(page_roots)
+        if len(self._group_ys) >= self.gc_group:
+            self._flush_group()
         self._batches += 1
         if self.exact_replay:
             if (
@@ -184,8 +201,28 @@ class DeviceNFA:
             return []
         return self.drain()
 
+    def _flush_group(self) -> None:
+        """Fold the accumulated group window back into the node region
+        (one mark/sweep over the concatenated per-advance node planes).
+        Runs on the G-th advance or early -- before anything that reads
+        pool node planes (drain, live_runs, snapshot)."""
+        if not self._group_ys:
+            return
+        ys_cat, roots_cat = concat_group_window(
+            self._group_ys, self._group_roots
+        )
+        self._group_ys = []
+        self._group_roots = []
+        self.state, self.pool = self._flush_post(
+            self.state, self.pool, ys_cat, roots_cat
+        )
+        self.flushes += 1
+
     def drain(self) -> List[Sequence]:
-        """Decode and clear all pending matches (a device sync point)."""
+        """Decode and clear all pending matches (a device sync point).
+        Forces an early group flush first (pending matches may reference
+        window node ids the pool planes don't cover mid-group)."""
+        self._flush_group()
         matches = self._decode_matches()
         if self.exact_replay:
             matches = self._replay_boundary(matches)
@@ -352,7 +389,10 @@ class DeviceNFA:
         """Serialize the full engine state to bytes (device arrays pulled as
         raw typed frames + the host event registry). The device analog of
         the reference's per-record NFAStates externalization
-        (CEPProcessor.java:144-147), taken at batch granularity."""
+        (CEPProcessor.java:144-147), taken at batch granularity. Forces an
+        early group flush first: the accumulated node window lives outside
+        the serialized pool (gc_phase is always 0 in a snapshot)."""
+        self._flush_group()
         from ..state.serde import (
             _Writer,
             MAGIC,

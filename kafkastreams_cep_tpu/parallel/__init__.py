@@ -5,6 +5,8 @@ from .stacked import StackedQueryEngine
 from .key_shard import (
     KEY_AXIS,
     build_batched_advance,
+    build_batched_append,
+    build_batched_flush,
     build_batched_post,
     global_stats,
     init_batched_pool,
@@ -20,6 +22,8 @@ __all__ = [
     "StackedQueryEngine",
     "KEY_AXIS",
     "build_batched_advance",
+    "build_batched_append",
+    "build_batched_flush",
     "build_batched_post",
     "global_stats",
     "init_batched_pool",

@@ -26,14 +26,20 @@ import jax.numpy as jnp
 
 from ..core.event import Event
 from ..core.sequence import Sequence
-from ..ops.engine import EngineConfig, drain_pend, eval_stateless_preds
+from ..ops.engine import (
+    WINDOW_PLANES,
+    EngineConfig,
+    drain_pend,
+    eval_stateless_preds,
+)
 from ..ops.runtime import decode_chains, materialize_sequence
 from ..ops.schema import EventSchema
 from ..ops.tables import CompiledQuery, compile_query
 from ..pattern.stages import Stages
 from .key_shard import (
     build_batched_advance,
-    build_batched_post,
+    build_batched_append,
+    build_batched_flush,
     init_batched_pool,
     init_batched_state,
     shard_state,
@@ -60,6 +66,14 @@ class BatchedDeviceNFA:
     kernel envelope -- the reason lands in `engine_fallback_reason`);
     "xla" / "pallas" force a path; "pallas_interpret" runs the kernel in
     the Pallas interpreter (conformance tests on CPU).
+
+    GC cadence is decoupled from advance cadence: the pend append runs
+    every advance, but the full mark/sweep + compaction folds the node
+    window back only every `EngineConfig.gc_group` advances (drains,
+    checkpoints, key growth and region pressure force an early group
+    flush, so the cadence changes WHEN garbage is collected, never what).
+    `target_emit_ms` arms per-advance flat micro-drains against that
+    budget for latency-bound deployments (see __init__).
     """
 
     #: exact-replay event-ledger bound (batches per drain interval); see
@@ -78,6 +92,8 @@ class BatchedDeviceNFA:
         auto_drain: bool = True,
         exact_replay: bool = True,
         drain_mode: str = "flat",
+        target_emit_ms: Optional[float] = None,
+        profile_sync: bool = False,
     ) -> None:
         if drain_mode not in ("flat", "pool"):
             raise ValueError(f"unknown drain_mode {drain_mode!r}")
@@ -112,7 +128,8 @@ class BatchedDeviceNFA:
         if self.engine.startswith("pallas"):
             from ..ops.pallas_step import (
                 build_pallas_batched_advance,
-                build_pallas_batched_post,
+                build_pallas_batched_append,
+                build_pallas_batched_flush,
             )
 
             self._advance = build_pallas_batched_advance(
@@ -120,15 +137,45 @@ class BatchedDeviceNFA:
                 interpret=(self.engine == "pallas_interpret"),
                 mesh=mesh,
             )
-            self._post = build_pallas_batched_post(
+            self._append = build_pallas_batched_append(self.config, mesh=mesh)
+            self._flush = build_pallas_batched_flush(
                 self.query, self.config, mesh=mesh
             )
         else:
             self._advance = build_batched_advance(self.query, self.config)
-            self._post = build_batched_post(self.query, self.config)
+            self._append = build_batched_append(self.config)
+            self._flush = build_batched_flush(self.query, self.config)
         self._drain_pend = jax.jit(drain_pend)
-        # post (pend-append + GC) runs every advance: node ids are only
-        # stable across advances through its remap.
+        #: GC group cadence (EngineConfig.gc_group): the pend append runs
+        #: every advance (capacity guards observe true counts) but the
+        #: full mark/sweep + compaction folds the accumulated node window
+        #: back only on the G-th advance -- or earlier, when a drain,
+        #: checkpoint, key growth or region pressure forces a group flush
+        #: (node ids are only region-stable through the flush's remap, so
+        #: anything that reads pool node planes flushes first).
+        self.gc_group = max(int(self.config.gc_group), 1)
+        #: pending group window: per-advance ys node planes + appended
+        #: page roots since the last flush (device-resident; concatenated
+        #: along the step axis at flush time).
+        self._group_ys: List[Dict[str, jnp.ndarray]] = []
+        self._group_roots: List[jnp.ndarray] = []
+        #: observability: total group flushes (tests pin the cadence).
+        self.flushes = 0
+        #: Micro-drain dial: with `target_emit_ms` set, every advance may
+        #: trigger a flat micro-drain (group flush + ring pull + overlapped
+        #: decode) once half the emit budget has elapsed since the last
+        #: pull, bounding match-emit latency by the advance cadence instead
+        #: of the caller's drain cadence. 0 micro-drains every advance.
+        self.target_emit_ms = target_emit_ms
+        #: CPU-contract profiling: block after the advance and after the
+        #: post section so BatchTimings records COMPUTE walls instead of
+        #: async dispatch walls (which stay ~constant in G and would hide
+        #: the flush amortization the smoke sweep pins). Disables the
+        #: zero-sync pipeline -- bench/CI use only.
+        self.profile_sync = profile_sync
+        import time as _time
+
+        self._last_pull_t = _time.perf_counter()
         #: Capacity guard against silent match loss (the reference never
         #: drops a match, SharedVersionedBufferStoreImpl.java:101-126): a
         #: non-decoding advance can append at most T * matches_per_step ids
@@ -260,7 +307,10 @@ class BatchedDeviceNFA:
 
         The jitted advance/GC retrace for the new [K] extent (shape change),
         so callers should grow geometrically (see streams/device_processor).
+        Forces an early group flush: the accumulated window carries the old
+        key extent and cannot be concatenated with grown state.
         """
+        self._flush_group()
         for k in new_keys:
             if k in self.key_index:
                 raise KeyError(f"key {k!r} already assigned")
@@ -507,6 +557,12 @@ class BatchedDeviceNFA:
                     self._submit_decode(raw)
                 elif region_pressure and not ring_full:
                     self._region_backoff = True
+                if region_pressure:
+                    # The pull cleared the pins; only the mark/sweep
+                    # actually reclaims region space, so region pressure
+                    # forces the early group flush the drain alone no
+                    # longer implies (flush-free flat drains).
+                    self._flush_group()
                 self._pend_accum = 0
         if self._pack_hwms:
             self._processed_gidx = max(
@@ -562,10 +618,25 @@ class BatchedDeviceNFA:
             )
             warnings.warn(self.engine_fallback_reason)
             self._advance = build_batched_advance(self.query, self.config)
-            self._post = build_batched_post(self.query, self.config)
+            self._append = build_batched_append(self.config)
+            self._flush = build_batched_flush(self.query, self.config)
             self.state, ys = self._advance(self.state, xs)
+        if self.profile_sync:
+            jax.block_until_ready(ys)
         t_adv = _time.perf_counter()
-        self.state, self.pool = self._post(self.state, self.pool, ys)
+        # Per-advance light post: pend append (capacity guards keep
+        # observing true counts) + group-phase bump; the node window and
+        # page roots accumulate device-side until the G-th advance's
+        # flush folds them back in one mark/sweep.
+        self.state, self.pool, page_roots = self._append(
+            self.state, self.pool, ys
+        )
+        self._group_ys.append({k: ys[k] for k in WINDOW_PLANES})
+        self._group_roots.append(page_roots)
+        if len(self._group_ys) >= self.gc_group:
+            self._flush_group()
+        if self.profile_sync:
+            jax.block_until_ready((self.state, self.pool))
         self._batches += 1
         self._pend_accum += step_cap
         if self.auto_drain and step_cap <= self.config.matches:
@@ -580,6 +651,31 @@ class BatchedDeviceNFA:
             t_adv - t0, int(np.prod(xs["valid"].shape)),
             post_s=_time.perf_counter() - t_adv,
         )
+        if (
+            self.target_emit_ms is not None
+            and not decode
+            and (_time.perf_counter() - self._last_pull_t) * 1e3
+            >= self.target_emit_ms / 2
+        ):
+            # Per-advance flat micro-drain (the emit-latency contract's
+            # lever): pull the ring once half the emit budget has elapsed
+            # and decode on the worker thread, so a match never waits for
+            # the caller's drain cadence. Cheap since the flat drain's
+            # D2H tracks match volume (PR 3) and the group flush it forces
+            # is the GC that would have run anyway, just earlier. Gated on
+            # the freshest probed TRUE cursor like the region-pressure
+            # trigger above (ADVICE r5): a probe that observed zero
+            # pending means the pull would be a pure no-op device sync --
+            # the exact stall this dial must not inflict on match-free
+            # streams. A pull invalidates in-flight probes (_ring_cleared
+            # bumps the epoch), so on active streams the observation is
+            # None and every due advance still pulls; quiet streams go
+            # probe-silent after at most two no-op pulls.
+            _, _, probed_pos = self._occupancy_bound()
+            if probed_pos is None or probed_pos > 0:
+                raw = self._pull_raw()
+                if raw is not None:
+                    self._submit_decode(raw)
         out: Dict[Any, List[Sequence]] = {}
         if decode:
             out = self.drain()
@@ -650,8 +746,13 @@ class BatchedDeviceNFA:
         # Prune AFTER decoding: the raw snapshot's chains reference events
         # by gidx, and materialized Sequences hold the Event objects. The
         # decode worker is idle here (all futures joined above), so the
-        # registry rebind cannot race an in-flight decode.
-        self._prune_events()  # registry must stay bounded on match-free streams
+        # registry rebind cannot race an in-flight decode. Mid-group
+        # (flush-free flat drain) the prune is skipped: window nodes
+        # reference events the region planes don't show, and the prune
+        # keeps only region-referenced + not-yet-advanced gidx. The next
+        # group-boundary drain prunes.
+        if not self._group_ys:
+            self._prune_events()  # registry stays bounded on match-free streams
         self.timings.record_drain(
             _time.perf_counter() - t0, sum(len(v) for v in out.values()),
             pull_s=pull_s, decode_s=decode_s, bytes_pulled=bytes_pulled,
@@ -775,9 +876,14 @@ class BatchedDeviceNFA:
 
     # --------------------------------------------------------- checkpointing
     def snapshot(self) -> bytes:
-        """Serialize the [K]-stacked engine state + key list + registry."""
+        """Serialize the [K]-stacked engine state + key list + registry.
+
+        Forces an early group flush first: the accumulated node window
+        lives outside the serialized pool, so a mid-group checkpoint
+        folds it back (gc_phase is therefore always 0 in a snapshot)."""
         import pickle
 
+        self._flush_group()
         from ..state.serde import (
             _Writer,
             MAGIC,
@@ -939,10 +1045,41 @@ class BatchedDeviceNFA:
         return self._pend_accum, 0, None
 
     def _ring_cleared(self) -> None:
-        """The pend ring was just drained: invalidate in-flight probes."""
+        """The pend ring was just drained: invalidate in-flight probes and
+        blank the group's accumulated page roots -- every match they
+        pinned was just pulled, so re-pinning their chains at the flush
+        would retain garbage G=1 collects (and break the G == G=1 bitwise
+        contract). The window node planes stay: live lanes still point
+        into them."""
         self._drain_epoch += 1
         self._pos_obs = None
         self._pend_accum = 0
+        if self._group_roots:
+            self._group_roots = [
+                jnp.full_like(r, -1) for r in self._group_roots
+            ]
+
+    def _flush_group(self) -> None:
+        """Fold the accumulated group window back into the node region:
+        one mark/sweep + compaction over the concatenated per-advance ys
+        node planes and page roots (engine.build_gc sizes itself from the
+        window shape, so a partial group just flushes a shorter window).
+        Runs on the G-th advance or early -- before anything that reads
+        pool node planes or assumes region-stable node ids (drains,
+        checkpoints, key growth, replay resync)."""
+        if not self._group_ys:
+            return
+        from ..ops.engine import concat_group_window
+
+        ys_cat, roots_cat = concat_group_window(
+            self._group_ys, self._group_roots
+        )
+        self._group_ys = []
+        self._group_roots = []
+        self.state, self.pool = self._flush(
+            self.state, self.pool, ys_cat, roots_cat
+        )
+        self.flushes += 1
 
     def _drain_compact(self):
         """The jitted drain-side compactor: walk the PRECISE pend-reachable
@@ -1047,12 +1184,64 @@ class BatchedDeviceNFA:
         path). Decode happens separately (`_decode_raw`, normally on the
         worker thread via `_submit_decode`) so the D2H wait and the Python
         materialization overlap the next dispatched batch. Returns None
-        when nothing is pending."""
+        when nothing is pending.
+
+        Mid-group, pending matches may reference window node ids the
+        region planes don't cover. The flat path drains from a VIRTUAL
+        pool view (region planes ++ the accumulated window segments) so a
+        micro-drain does NOT collapse the GC cadence back to per-advance
+        -- the whole point of gc_group on the latency path. Exact replay
+        forces a real flush instead: its drain-boundary snapshot must be
+        self-contained (lane nodes resolvable against the serialized-pool
+        planes alone). The pool path (the semantic reference) flushes too.
+        """
+        import time as _time
+
+        self._last_pull_t = _time.perf_counter()
+        if self.drain_mode == "flat" and not self.exact_replay:
+            return self._pull_raw_flat(self._window_pool_view())
+        self._flush_group()
         if self.drain_mode == "flat":
-            return self._pull_raw_flat()
+            return self._pull_raw_flat(self.pool)
         return self._pull_raw_pool()
 
-    def _pull_raw_flat(self) -> Optional[Dict[str, Any]]:
+    def _window_pool_view(self) -> Dict[str, jnp.ndarray]:
+        """The drain-time virtual pool: node planes with the group's
+        accumulated window segments appended past the region, so window
+        ids (B + global step * cap + slot) index it directly. Ring leaves
+        are the real pool's. A no-op (the pool itself) at group
+        boundaries.
+
+        The view is padded to the FULL group extent (gc_group segments of
+        the first segment's step count) with invalid rows (-1: no valid
+        node, never a chain target), so the jitted probe/flatten compile
+        for ONE view shape per (T, G) instead of one per fill level --
+        without the padding, per-batch micro-drains walked G distinct
+        shapes per group cycle and paid G probe compiles (minutes each at
+        flagship plane sizes)."""
+        if not self._group_ys:
+            return self.pool
+        pallas = self.engine.startswith("pallas")
+        planes = {"node_event": "w_event", "node_name": "w_name",
+                  "node_pred": "w_pred"}
+        out = dict(self.pool)
+        n_pad = self.gc_group - len(self._group_ys)
+        for plane, wkey in planes.items():
+            segs = [self.pool[plane]]
+            for ys in self._group_ys:
+                w = ys[wkey]
+                if pallas:  # [T, K, cap] -> [T, cap, K]
+                    w = jnp.transpose(w, (0, 2, 1))
+                segs.append(w.reshape((-1,) + w.shape[2:]))
+            if n_pad > 0:
+                segs.append(jnp.full(
+                    (n_pad * segs[1].shape[0],) + segs[1].shape[1:],
+                    -1, segs[1].dtype,
+                ))
+            out[plane] = jnp.concatenate(segs, axis=0)
+        return out
+
+    def _pull_raw_flat(self, pool_view) -> Optional[Dict[str, Any]]:
         """Chain-flatten drain: ONE fused [3, K] probe (counts, cursors,
         chain-depth bound -- engine.drain_probe), then one jitted device
         pass (engine.build_chain_flatten) walks every pending chain into a
@@ -1060,7 +1249,12 @@ class BatchedDeviceNFA:
         asynchronously. No node-pool plane crosses the tunnel: drain bytes
         are bounded by true match volume (matches x chain depth), not pool
         capacity. Mb/Cb are pow2 buckets of the probed per-key maxima, so
-        distinct compiled programs stay O(log M x log B)."""
+        distinct compiled programs stay O(log M x log B).
+
+        `pool_view` is the real pool at group boundaries, or the virtual
+        region++window view mid-group (_window_pool_view): the walk and
+        the probe read the view; the ring clear always hits the real
+        pool."""
         import time as _time
 
         if self._drain_probe_fn is None:
@@ -1068,7 +1262,7 @@ class BatchedDeviceNFA:
 
             self._drain_probe_fn = jax.jit(drain_probe)
         t0 = _time.perf_counter()
-        probe = np.asarray(self._drain_probe_fn(self.pool))  # the one sync
+        probe = np.asarray(self._drain_probe_fn(pool_view))  # the one sync
         counts = probe[0]
         self.last_match_counts = counts
         if counts.sum() == 0:
@@ -1076,8 +1270,8 @@ class BatchedDeviceNFA:
                 self.pool = self._drain_pend(self.pool)  # reclaim cursor
             self._ring_cleared()
             return None
-        full_m = self.pool["pend"].shape[0]
-        full_b = self.pool["node_event"].shape[0]
+        full_m = pool_view["pend"].shape[0]
+        full_b = pool_view["node_event"].shape[0]
         Mb = 1
         while Mb < max(int(counts.max()), 1):
             Mb <<= 1
@@ -1091,7 +1285,7 @@ class BatchedDeviceNFA:
             from ..ops.engine import build_chain_flatten
 
             fn = self._flatten_fns[(Mb, Cb)] = build_chain_flatten(Mb, Cb)
-        table = fn(self.pool)  # [3, Mb, Cb, K] device-side
+        table = fn(pool_view)  # [3, Mb, Cb, K] device-side
         try:
             table.copy_to_host_async()
         except Exception:

@@ -76,35 +76,47 @@ def build_batched_advance(query: CompiledQuery, config: EngineConfig):
             x, t = xt
             return vstep(carry, x, t)
 
+        # The group-phase step offset stays UNBATCHED (first key's scalar:
+        # the drivers advance/flush all keys in lockstep, so every key
+        # carries the same phase) -- a per-key t would break the shared
+        # time-indexed window layout.
         state, ys = jax.lax.scan(
-            body, state, (xs, jnp.arange(T, dtype=jnp.int32))
+            body, state,
+            (xs, state["gc_phase"][0] + jnp.arange(T, dtype=jnp.int32)),
         )
         return state, ys
 
     return advance
 
 
-def build_batched_post(query: CompiledQuery, config: EngineConfig):
-    """jit-compiled multi-key post pass: unvmapped dense scatter-append
-    (the page scatters every key's real ids at its own count cursor in one
-    op) + the per-key GC vmapped over the trailing key axis + the ring
+def build_batched_append(config: EngineConfig):
+    """jit-compiled multi-key per-advance light post: the unvmapped dense
+    scatter-append (every key's real match ids land at its own count
+    cursor in one op) + the group-phase bump. The mark/sweep GC is
+    deferred to the group flush (build_batched_flush); capacity guards
+    keep observing true pending counts because the append stays
+    per-advance."""
+    from ..ops.engine import build_append_post
+
+    return jax.jit(build_append_post(config))
+
+
+def build_batched_flush(query: CompiledQuery, config: EngineConfig):
+    """jit-compiled multi-key group flush: the per-key GC vmapped over the
+    trailing key axis, run on the group's ACCUMULATED window (ys node
+    planes + page roots concatenated along the step axis), + the ring
     remap as a dynamic block loop over the occupied prefix
     (engine.remap_pend_blocks -- the remap cost tracks true occupancy,
-    which only the device knows).
-    """
-    from ..ops.engine import build_gc, build_pend_append, remap_pend_blocks
+    which only the device knows). Resets the group-phase scalar."""
+    from ..ops.engine import build_gc, remap_pend_blocks
 
-    append = build_pend_append(config)
     gc = jax.vmap(
         build_gc(query, config, defer_pend_remap=True),
         in_axes=(-1, -1, -1, -1), out_axes=(-1, -1, -1),
     )
 
     @jax.jit
-    def post(state, pool, ys):
-        state, pool, page_roots = append(
-            state, pool, ys["w_match"], ys["w_mroot"]
-        )
+    def flush(state, pool, ys, page_roots):
         state, pool, remap_full = gc(state, pool, ys, page_roots)
         pool = {
             **pool,
@@ -112,6 +124,36 @@ def build_batched_post(query: CompiledQuery, config: EngineConfig):
                 pool["pend"], remap_full, pool["pend_pos"]
             ),
         }
+        state = {**state, "gc_phase": jnp.zeros_like(state["gc_phase"])}
+        return state, pool
+
+    return flush
+
+
+def build_batched_post(query: CompiledQuery, config: EngineConfig):
+    """jit-compiled multi-key every-advance post pass (append + GC in one
+    jit): the G=1 composition kept for tests and one-shot callers; the
+    batched driver runs build_batched_append/build_batched_flush at the
+    group cadence (EngineConfig.gc_group)."""
+    from ..ops.engine import build_append_post, build_gc, remap_pend_blocks
+
+    append = build_append_post(config)
+    gc = jax.vmap(
+        build_gc(query, config, defer_pend_remap=True),
+        in_axes=(-1, -1, -1, -1), out_axes=(-1, -1, -1),
+    )
+
+    @jax.jit
+    def post(state, pool, ys):
+        state, pool, page_roots = append(state, pool, ys)
+        state, pool, remap_full = gc(state, pool, ys, page_roots)
+        pool = {
+            **pool,
+            "pend": remap_pend_blocks(
+                pool["pend"], remap_full, pool["pend_pos"]
+            ),
+        }
+        state = {**state, "gc_phase": jnp.zeros_like(state["gc_phase"])}
         return state, pool
 
     return post

@@ -134,6 +134,11 @@ def upgrade_checkpoint_trees(
             for k in range(K):
                 root[:, k] = _chain_roots(node[:, k], pred[:, k])
             state["root"] = root
+    if "gc_phase" not in state:
+        # GC groups (EngineConfig.gc_group): pre-group checkpoints carry no
+        # group-phase scalar; snapshots always flush the group window
+        # first, so 0 is exact, not approximate.
+        state["gc_phase"] = np.zeros_like(np.asarray(state["runs"], np.int32))
 
 
 def _default_serialize(obj: Any) -> bytes:

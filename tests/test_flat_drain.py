@@ -305,6 +305,53 @@ def test_region_pressure_guard_gates_on_probed_cursor():
     assert len(pulls) == 1, "backoff must suppress the region trigger"
 
 
+def test_pin_interval_crossed_with_flat_drain():
+    """The bench's flagship combination (bench.py skip_any8_batched runs
+    pin_interval=True with drain_mode="flat") was previously covered only
+    one axis at a time. Under interval pinning the drain-side compaction
+    must still re-derive the EXACT pend closure (the pinned bitmap
+    over-approximates by design), so pin x {flat, pool} x precise-walk
+    must all agree across a mid-stream drain boundary -- same matches,
+    same order, same fold values -- with zero drops at this sizing."""
+    pattern = branching_pattern()
+    keys = [f"k{i}" for i in range(3)]
+    streams = {
+        k: letter_stream(4000 + i, 24) for i, k in enumerate(keys)
+    }
+
+    def run(pin, mode):
+        config = EngineConfig(
+            lanes=64, nodes=1024, matches=256, matches_per_step=16,
+            pin_interval=pin,
+        )
+        bat = BatchedDeviceNFA(
+            compile_pattern(pattern), keys=keys, config=config,
+            drain_mode=mode,
+        )
+        got = {k: [] for k in keys}
+        # Three undrained advances (pins must keep the pending chains
+        # alive across those GC passes), a mid-stream drain boundary,
+        # three more, then the final drain.
+        for b in range(6):
+            bat.advance_packed(
+                bat.pack({k: s[b * 4:(b + 1) * 4] for k, s in streams.items()}),
+                decode=False,
+            )
+            if b == 2:
+                for k, seqs in bat.drain().items():
+                    got[k].extend(seqs)
+        for k, seqs in bat.drain().items():
+            got[k].extend(seqs)
+        st = bat.stats
+        assert st["node_drops"] == 0 and st["match_drops"] == 0, (pin, mode)
+        return got
+
+    want = run(False, "pool")  # precise walks + the semantic reference pull
+    assert run(True, "flat") == want   # the bench combination
+    assert run(True, "pool") == want
+    assert run(False, "flat") == want
+
+
 def test_flat_drain_stacked_queries():
     """Stacked multi-query attribution (qid routing) through the flat
     table: flat == pool on a 2-query stack."""

@@ -1,0 +1,478 @@
+"""GC groups: multi-advance GC cadence pinned bitwise-equal to G=1.
+
+EngineConfig.gc_group decouples the mark/sweep GC cadence from the advance
+cadence: the pend append runs every advance, the full mark/sweep +
+compaction folds the accumulated time-indexed node window back only on the
+G-th advance (or earlier, when a drain / checkpoint / region-pressure
+trigger forces a group flush). The cadence must change WHEN garbage is
+collected, never what the engine computes. This module pins, for
+G in {2, 4, 8} against G=1:
+
+  * same matches, same order, same fold values (Sequence equality covers
+    the materialized content), same drop counters -- across branching,
+    capacity-pressure, mid-group drain, mid-group checkpoint/restore and
+    exact-replay-boundary cases;
+  * the FINAL engine state and node pool bitwise (the stable sweep makes
+    region layout a pure function of the reachable set, so deferring the
+    fold must reproduce the exact compaction);
+  * both step engines (XLA scan step and the fused pallas kernel in
+    interpret mode) and both drain modes (flat and pool);
+  * the single-key DeviceNFA runtime, including mid-group live_runs();
+  * the flush cadence itself (flushes == advances/G + forced flushes) --
+    the post-amortization contract BatchTimings.components() reports.
+"""
+import math
+import random
+
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu import (
+    NFA,
+    AggregatesStore,
+    Event,
+    QueryBuilder,
+    Selected,
+    SharedVersionedBuffer,
+    compile_pattern,
+)
+from kafkastreams_cep_tpu.ops.engine import EngineConfig
+from kafkastreams_cep_tpu.ops.runtime import DeviceNFA
+from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA
+from kafkastreams_cep_tpu.pattern.expressions import agg, value
+
+TS = 1_000_000
+
+
+def branching_fold_pattern():
+    """skip-till-any + one_or_more + fold: branching, shared chain
+    prefixes, fold registers -- every structure the deferred window must
+    carry across advances."""
+    return (
+        QueryBuilder()
+        .select("first")
+        .where(value() == "A")
+        .fold("cnt", agg("cnt", default=0) + 1)
+        .then()
+        .select("second", Selected.with_skip_til_any_match())
+        .one_or_more()
+        .where(value() == "C")
+        .then()
+        .select("latest")
+        .where(value() == "D")
+        .build()
+    )
+
+
+def abc_pattern():
+    return (
+        QueryBuilder()
+        .select("a").where(value() == "A")
+        .then().select("b").where(value() == "B")
+        .then().select("c").where(value() == "C")
+        .build()
+    )
+
+
+def letter_stream(seed, n, key="k"):
+    rng = random.Random(seed)
+    return [
+        Event(key, rng.choice("ABCD"), TS + i, "t", 0, i) for i in range(n)
+    ]
+
+
+def assert_trees_equal(a, b, what):
+    """Bitwise equality of two state/pool dicts of device arrays."""
+    assert set(a) == set(b)
+    for name in a:
+        la, lb = np.asarray(a[name]), np.asarray(b[name])
+        assert la.dtype == lb.dtype, f"{what}[{name}] dtype"
+        assert np.array_equal(la, lb), f"{what}[{name}] diverged"
+
+
+def drive_batched(
+    G, streams, pattern, config_kw, drain_at, T=4, engine="xla",
+    drain_mode="flat",
+):
+    """Advance T-event batches with deferred decode, draining only at the
+    advance indices in `drain_at` (mid-group for G > 1) plus a terminal
+    drain; returns (matches, engine)."""
+    keys = list(streams)
+    config = EngineConfig(gc_group=G, **config_kw)
+    bat = BatchedDeviceNFA(
+        compile_pattern(pattern), keys=keys, config=config, engine=engine,
+        drain_mode=drain_mode,
+    )
+    got = {k: [] for k in keys}
+    n = max(len(s) for s in streams.values())
+    for b in range(math.ceil(n / T)):
+        chunk = {
+            k: s[b * T: (b + 1) * T]
+            for k, s in streams.items()
+            if s[b * T: (b + 1) * T]
+        }
+        bat.advance_packed(bat.pack(chunk), decode=False)
+        if b in drain_at:
+            for k, seqs in bat.drain().items():
+                got[k].extend(seqs)
+    for k, seqs in bat.drain().items():
+        got[k].extend(seqs)
+    return got, bat
+
+
+@pytest.mark.parametrize("G", [2, 4, 8])
+def test_groups_bitwise_equal_g1_branching(G):
+    """G in {2, 4, 8} == G=1 on the branching + fold query with fully
+    deferred decode: same matches (order and fold values included), same
+    counters, and the final state + pool bitwise."""
+    streams = {f"k{i}": letter_stream(900 + i, 24, f"k{i}") for i in range(3)}
+    kw = dict(lanes=64, nodes=512, matches=512)
+    want, b1 = drive_batched(1, streams, branching_fold_pattern(), kw, ())
+    got, bg = drive_batched(G, streams, branching_fold_pattern(), kw, ())
+    assert got == want
+    assert bg.stats == b1.stats
+    assert_trees_equal(b1.state, bg.state, "state")
+    assert_trees_equal(b1.pool, bg.pool, "pool")
+    # G=1 flushes every advance; G > 1 folds 1/G as often (same 6 advances,
+    # one terminal drain-forced flush at most on top).
+    assert b1.flushes == 6
+    assert bg.flushes == math.ceil(6 / G)
+
+
+@pytest.mark.parametrize("G", [2, 4])
+def test_groups_mid_group_drain(G):
+    """Drains landing mid-group (advance index not a multiple of G) force
+    an early flush; matches and final state must still equal G=1's."""
+    streams = {f"k{i}": letter_stream(910 + i, 24, f"k{i}") for i in range(2)}
+    kw = dict(lanes=64, nodes=512, matches=512)
+    drain_at = (0, 2)  # advances 1 and 3: both mid-group for G in {2, 4}
+    want, b1 = drive_batched(1, streams, branching_fold_pattern(), kw, drain_at)
+    got, bg = drive_batched(G, streams, branching_fold_pattern(), kw, drain_at)
+    assert got == want
+    assert bg.stats == b1.stats
+    assert_trees_equal(b1.state, bg.state, "state")
+    assert_trees_equal(b1.pool, bg.pool, "pool")
+
+
+def branching_nofold_pattern():
+    """Branching without folds: exact replay stays disarmed, so drains
+    ride the flush-free region++window view instead of forcing a flush."""
+    return (
+        QueryBuilder()
+        .select("first")
+        .where(value() == "A")
+        .then()
+        .select("second", Selected.with_skip_til_any_match())
+        .one_or_more()
+        .where(value() == "C")
+        .then()
+        .select("latest")
+        .where(value() == "D")
+        .build()
+    )
+
+
+@pytest.mark.parametrize("G", [2, 4])
+def test_groups_window_view_drain_keeps_cadence(G):
+    """Mid-group flat drains on a replay-disarmed query decode matches
+    whose chains still live in the accumulated window (the region++window
+    view) WITHOUT forcing a flush: output equals G=1's across every drain
+    (including a long post-drain continuation, which proves the engine
+    state stayed equivalent), and the flush count stays advances/G -- the
+    latency path's whole point. (Final trees are NOT compared bitwise
+    here: a flush-free run legitimately ends mid-group, so its region
+    only aligns with G=1's at flush boundaries; the flush-forcing suites
+    above pin that.)"""
+    streams = {f"k{i}": letter_stream(915 + i, 48, f"k{i}") for i in range(2)}
+    kw = dict(lanes=64, nodes=512, matches=512)
+    drain_at = (0, 2, 4, 7)  # mostly mid-group for G in {2, 4}
+    want, b1 = drive_batched(
+        1, streams, branching_nofold_pattern(), kw, drain_at, T=4
+    )
+    got, bg = drive_batched(
+        G, streams, branching_nofold_pattern(), kw, drain_at, T=4
+    )
+    assert got == want
+    assert bg.stats == b1.stats
+    # 12 advances: the mid-group drains must not have forced extra flushes.
+    assert not bg.exact_replay
+    assert bg.flushes == 12 // G
+
+
+@pytest.mark.parametrize("G", [4])
+def test_groups_pool_drain_mode(G):
+    """The pool-pull drain (the semantic reference path) under GC groups:
+    the early flush must land before the closure walk reads pool planes."""
+    streams = {f"k{i}": letter_stream(920 + i, 20, f"k{i}") for i in range(2)}
+    kw = dict(lanes=64, nodes=512, matches=512)
+    want, b1 = drive_batched(
+        1, streams, branching_fold_pattern(), kw, (1,), drain_mode="pool"
+    )
+    got, bg = drive_batched(
+        G, streams, branching_fold_pattern(), kw, (1,), drain_mode="pool"
+    )
+    assert got == want
+    assert bg.stats == b1.stats
+    assert_trees_equal(b1.pool, bg.pool, "pool")
+
+
+@pytest.mark.parametrize("G", [2, 4])
+def test_groups_capacity_pressure(G):
+    """Step-level caps (lanes, matches_per_step, nodes_per_step) and ring
+    pressure (auto-drain forcing early flushes) must drop IDENTICALLY
+    across G: the per-step transition and the per-advance append never
+    see the group size. (The pend ring is small enough that the capacity
+    guard forces mid-group host drains.)"""
+    streams = {f"k{i}": letter_stream(930 + i, 32, f"k{i}") for i in range(2)}
+    kw = dict(lanes=8, nodes=512, matches=24, matches_per_step=4,
+              nodes_per_step=8)
+    want, b1 = drive_batched(1, streams, branching_fold_pattern(), kw, ())
+    got, bg = drive_batched(G, streams, branching_fold_pattern(), kw, ())
+    assert got == want
+    # Drain cadence (probe timing) may differ between runs; matches and
+    # counters may not -- the drops here come from the deterministic
+    # per-step caps (multi-match steps overflowing matches_per_step and
+    # the lane pool), which fire identically at every G.
+    assert bg.stats == b1.stats
+    assert bg.stats["lane_drops"] > 0 or bg.stats["match_drops"] > 0
+
+
+@pytest.mark.parametrize("G", [2, 4, 8])
+def test_groups_mid_group_checkpoint_restore(G):
+    """A snapshot taken mid-group forces an early flush (the accumulated
+    window lives outside the serialized pool): restore + continue must
+    equal the G=1 run, and the serialized gc_phase must be 0."""
+    from kafkastreams_cep_tpu.state.serde import (
+        _Reader, decode_array_tree, read_magic,
+    )
+    import pickle
+
+    streams = {f"k{i}": letter_stream(940 + i, 24, f"k{i}") for i in range(2)}
+    pattern = branching_fold_pattern()
+
+    def run(G):
+        keys = list(streams)
+        config = EngineConfig(lanes=64, nodes=512, matches=512, gc_group=G)
+        bat = BatchedDeviceNFA(
+            compile_pattern(pattern), keys=keys, config=config
+        )
+        for b in range(3):  # 3 advances: mid-group for every G > 1
+            bat.advance_packed(
+                bat.pack({k: s[b * 4: (b + 1) * 4] for k, s in streams.items()}),
+                decode=False,
+            )
+        blob = bat.snapshot()
+        r = _Reader(blob)
+        read_magic(r)
+        pickle.loads(r.blob())  # keys
+        tree = decode_array_tree(r.blob())
+        assert "gc_phase" in tree
+        assert int(np.asarray(tree["gc_phase"]).max()) == 0
+        bat2 = BatchedDeviceNFA.restore(
+            compile_pattern(pattern), blob, config=config
+        )
+        for b in range(3, 6):
+            bat2.advance_packed(
+                bat2.pack({k: s[b * 4: (b + 1) * 4] for k, s in streams.items()}),
+                decode=False,
+            )
+        return bat2.drain(), bat2
+
+    want, b1 = run(1)
+    got, bg = run(G)
+    assert got == want
+    assert bg.stats == b1.stats
+    assert_trees_equal(b1.state, bg.state, "state")
+    assert_trees_equal(b1.pool, bg.pool, "pool")
+
+
+@pytest.mark.parametrize("G", [2, 4])
+def test_groups_replay_boundary(G):
+    """Exact-replay boundaries (fold-divergence recovery) under GC groups:
+    the drain's early flush precedes the replay snapshot/resync, so the
+    grouped engine must agree with G=1 AND with the host oracle."""
+    rng = random.Random(50_072)
+    pattern = (
+        QueryBuilder()
+        .select("s0").where(value() == "A")
+        .then().select("s1", Selected.with_skip_til_any_match())
+        .one_or_more().where(value() == "B")
+        .fold("cnt", agg("cnt", default=0) + 1)
+        .then().select("s2").where(
+            (value() == "C") & (agg("cnt", default=0) <= 2)
+        )
+        .build()
+    )
+    keys = ["kA", "kB"]
+    streams = {}
+    for key in keys:
+        ts = 1000
+        events = []
+        for i in range(20):
+            ts += rng.choice([0, 1, 1, 2])
+            events.append(Event(key, rng.choice("ABCD"), ts, "t", 0, i))
+        streams[key] = events
+
+    stages = compile_pattern(pattern)
+    expected = {}
+    for key in keys:
+        oracle = NFA.build(stages, AggregatesStore(), SharedVersionedBuffer())
+        acc = []
+        for e in streams[key]:
+            acc.extend(oracle.match_pattern(e))
+        expected[key] = acc
+
+    kw = dict(lanes=256, nodes=2048, matches=1024, matches_per_step=128)
+    want, b1 = drive_batched(1, streams, pattern, kw, (1, 2), T=5)
+    got, bg = drive_batched(G, streams, pattern, kw, (1, 2), T=5)
+    assert got == want
+    assert bg.replays == b1.replays
+    for k in keys:
+        assert got.get(k, []) == expected[k], f"key {k} diverged from oracle"
+
+
+@pytest.mark.parametrize("G", [2, 4])
+def test_groups_pallas_interpret_engine(G):
+    """The fused pallas kernel (interpret mode) under GC groups: the
+    group-phase offset rides the xi event columns into the kernel; grouped
+    pallas must equal G=1 pallas bitwise."""
+    streams = {f"k{i}": letter_stream(950 + i, 12, f"k{i}") for i in range(8)}
+    kw = dict(lanes=16, nodes=256, matches=128, matches_per_step=8,
+              nodes_per_step=8)
+    want, b1 = drive_batched(
+        1, streams, abc_pattern(), kw, (), T=3, engine="pallas_interpret"
+    )
+    got, bg = drive_batched(
+        G, streams, abc_pattern(), kw, (), T=3, engine="pallas_interpret"
+    )
+    assert got == want
+    assert bg.stats == b1.stats
+    assert_trees_equal(b1.state, bg.state, "state")
+    assert_trees_equal(b1.pool, bg.pool, "pool")
+
+
+@pytest.mark.parametrize("G", [2, 8])
+def test_groups_single_key_runtime(G):
+    """The single-key DeviceNFA at the group cadence, including a
+    mid-group live_runs() (which must flush to read pool planes)."""
+    pattern = branching_fold_pattern()
+    evs = letter_stream(960, 24)
+
+    def run(G):
+        config = EngineConfig(lanes=64, nodes=512, matches=256, gc_group=G)
+        dev = DeviceNFA(compile_pattern(pattern), config=config)
+        out = []
+        for lo in range(0, 24, 4):
+            out.extend(dev.advance(evs[lo: lo + 4], decode=False))
+            if lo == 4:  # mid-group introspection for every G > 1
+                dev.live_runs()
+        out.extend(dev.drain())
+        return out, dev
+
+    want, d1 = run(1)
+    got, dg = run(G)
+    assert got == want
+    assert dg.stats == d1.stats
+    assert_trees_equal(d1.state, dg.state, "state")
+    assert_trees_equal(d1.pool, dg.pool, "pool")
+
+
+def test_flush_cadence_and_post_amortization():
+    """The contract behind the perf claim: at fixed T, the number of full
+    mark/sweep passes per advance falls as 1/G (BatchTimings.components()
+    'post' amortization is this cadence times the per-flush wall)."""
+    streams = {"k0": letter_stream(970, 48, "k0")}
+    flushes = {}
+    for G in (1, 2, 4):
+        _, bat = drive_batched(
+            G, streams, abc_pattern(), dict(lanes=8, nodes=256, matches=512),
+            (), T=4,
+        )
+        # 12 advances, one terminal drain (forces at most one extra flush).
+        assert bat.flushes == math.ceil(12 / G)
+        flushes[G] = bat.flushes
+        comp = bat.timings.components()
+        assert comp["advance_ms"] > 0.0
+    assert flushes[4] < flushes[2] < flushes[1]
+
+
+def test_target_emit_ms_micro_drains():
+    """target_emit_ms=0 arms a flat micro-drain on every due advance
+    (skipped only when a landed cursor probe observed an empty ring):
+    matches equal the plain deferred-decode engine's, nothing drops or
+    reorders -- and the micro-drains do NOT collapse the GC cadence:
+    mid-group pulls decode from the region++window view (no forced
+    flush), so the flush count stays advances/G."""
+    streams = {f"k{i}": letter_stream(980 + i, 36, f"k{i}") for i in range(2)}
+    keys = list(streams)
+    pattern = abc_pattern()
+
+    def run(target):
+        config = EngineConfig(
+            lanes=16, nodes=256, matches=4096, gc_group=4,
+            matches_per_step=4, nodes_per_step=8,
+        )
+        bat = BatchedDeviceNFA(
+            compile_pattern(pattern), keys=keys, config=config,
+            target_emit_ms=target,
+        )
+        pulls = [0]
+        orig = bat._pull_raw
+
+        def counting():
+            pulls[0] += 1
+            return orig()
+
+        bat._pull_raw = counting
+        for b in range(9):
+            bat.advance_packed(
+                bat.pack({k: s[b * 4: (b + 1) * 4] for k, s in streams.items()}),
+                decode=False,
+            )
+        return bat.drain(), pulls[0], bat
+
+    want, pulls_off, _ = run(None)
+    got, pulls_on, bat = run(0.0)
+    assert got == want
+    assert pulls_off == 1          # the terminal drain only (big ring)
+    # Micro pulls fire on due advances; the probe gate may skip an
+    # advance whose probe landed fast AND observed an empty ring, so the
+    # exact count is timing-dependent -- the contract is that the hook
+    # pulls repeatedly without waiting for the caller's drain.
+    assert 2 < pulls_on <= 10
+    assert bat.stats["match_drops"] == 0
+    # The emit-latency lever did not pay for itself with extra GCs: 9
+    # advances at G=4 flush twice, micro-drains or not (abc has no folds,
+    # so exact replay is disarmed and drains ride the window view).
+    assert bat.flushes == 2
+
+
+def test_target_emit_ms_gates_on_probed_cursor():
+    """An armed micro-drain must NOT turn a match-free stream into a
+    device-sync-per-advance loop: once the async cursor probes observe a
+    zero pending count, due advances skip the pull entirely (the same
+    probed-true-cursor gate as the region-pressure trigger). A couple of
+    cold-start pulls are allowed while the first probes land."""
+    key = "k0"
+    quiet = [Event(key, "X", TS + i, "t", 0, i) for i in range(36)]
+    config = EngineConfig(
+        lanes=16, nodes=256, matches=4096, gc_group=4,
+        matches_per_step=4, nodes_per_step=8,
+    )
+    bat = BatchedDeviceNFA(
+        compile_pattern(abc_pattern()), keys=[key], config=config,
+        target_emit_ms=0.0,
+    )
+    pulls = [0]
+    orig = bat._pull_raw
+
+    def counting():
+        pulls[0] += 1
+        return orig()
+
+    bat._pull_raw = counting
+    for b in range(9):
+        bat.advance_packed(bat.pack({key: quiet[b * 4: (b + 1) * 4]}),
+                           decode=False)
+    assert bat.drain() == {}
+    assert pulls[0] <= 5, "match-free micro-drain must go probe-silent"
