@@ -420,7 +420,9 @@ def bench_device_batched(
         bat.pack({k: s[b * batch: (b + 1) * batch] for k, s in streams.items()})
         for b in range(n_warm + n_batches + n_e2e, total_b)
     ]
-    bat.timings = BatchTimings()
+    # Fresh percentile window over the SAME registry: the spine's counters
+    # stay monotonic across the reset (prom semantics).
+    bat.timings = BatchTimings(registry=bat.metrics)
     lat_ms: List[float] = []
     lat_matches = 0
     for xs in lat_packed:
@@ -437,6 +439,10 @@ def bench_device_batched(
     # samples); D2H volume accounting comes from the engine itself.
     components = bat.timings.components()
     return dict(
+        # The engine registry's full exposition (stats pull above already
+        # refreshed the state-counter gauges): the `metrics` JSON contract
+        # scripts/check_bench_schema.py round-trips against prom text.
+        metrics=bat.metrics.snapshot(),
         events=n, seconds=dt, eps=n / dt, matches=n_matches,
         drain_s=drain_s,  # terminal drain, excluded from eps (own stage)
         e2e_eps=e2e_n / e2e_dt, e2e_matches=e2e_matches,
@@ -507,7 +513,7 @@ def bench_device_latency(
     for xs in packed[:n_warm]:
         bat.advance_packed(xs, decode=True)
     jax.block_until_ready(bat.state["n_events"])
-    bat.timings = BatchTimings()
+    bat.timings = BatchTimings(registry=bat.metrics)
     t0 = time.perf_counter()
     n_matches = 0
     if pipelined:
@@ -798,6 +804,9 @@ def main() -> None:
             "degraded; drain-side figures in this artifact understate the "
             "engine and MUST NOT be read as regressions"
         )
+    # The flagship engine's registry exposition rides the top level (the
+    # other configs' snapshots stay under their own detail dicts).
+    flagship_metrics = detail.get("skip_any8_batched", {}).pop("metrics", {})
     out = {
         "metric": "events_per_sec_skip_any8_batched",
         "value": round(headline, 1),
@@ -828,7 +837,29 @@ def main() -> None:
         # "Denominator" section).
         "denominator": "python_host_port_no_jvm_available",
         "configs": detail,
+        # The unified obs registry of the flagship batched engine
+        # (obs/registry.py snapshot format; PERF.md v10 documents every
+        # metric). scripts/check_bench_schema.py proves this section and
+        # its prom-text rendering carry the same values.
+        "metrics": flagship_metrics,
     }
+    if ARGS.smoke:
+        # Smoke artifacts must stay self-describing: validate the JSON
+        # contract (documented keys, component breakdown, metrics
+        # round-trip) before printing, and fail the run on violations.
+        sys.path.insert(
+            0,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
+        )
+        from check_bench_schema import validate as _validate_schema
+
+        errors = _validate_schema(out)
+        out["schema_ok"] = not errors
+        if errors:
+            for e in errors:
+                log(f"SCHEMA: {e}")
+            print(json.dumps(out))
+            sys.exit(1)
     print(json.dumps(out))
 
 

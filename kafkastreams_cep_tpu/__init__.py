@@ -28,6 +28,7 @@ from .streams.driver import LogDriver, produce
 from .streams.log import RecordLog
 from .streams.processor import CEPProcessor
 from .streams.serde import Queried, sequence_to_json
+from .obs import MetricsRegistry, SpanTracer, default_registry
 
 __version__ = "0.1.0"
 
@@ -94,6 +95,9 @@ __all__ = [
     "produce",
     "Queried",
     "sequence_to_json",
+    "MetricsRegistry",
+    "SpanTracer",
+    "default_registry",
     # lazy device-path exports
     "DeviceNFA",
     "BatchedDeviceNFA",

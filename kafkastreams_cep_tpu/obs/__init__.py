@@ -1,0 +1,30 @@
+"""Unified observability layer: metrics registry, exposition, span tracer.
+
+One telemetry spine instead of four private ones (ISSUE 5): every layer
+registers its counters/gauges/histograms here, and the registry exposes
+them as Prometheus 0.0.4 text (`to_prom_text`) or a JSON snapshot
+(`snapshot`) -- the same values bench.py emits and
+scripts/check_bench_schema.py validates. See PERF.md "v10" for the full
+metrics dictionary.
+"""
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    parse_prom_text,
+    registry_from_snapshot,
+)
+from .trace import SpanTracer
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SpanTracer",
+    "default_registry",
+    "parse_prom_text",
+    "registry_from_snapshot",
+]

@@ -1,0 +1,51 @@
+"""Span tracer: host-side wall spans + the device xplane trace, one API.
+
+`SpanTracer.span("restore")` times a host block and records it into the
+registry (`cep_span_seconds{span=...}` histogram + `cep_span_total`
+counter), so the streams layer's poll/commit/restore sections land in the
+same spine as the engine's section walls. `SpanTracer.device(log_dir)`
+wraps ops.profiling.device_trace (jax.profiler xplane capture) and records
+the capture wall as a span of the same name -- one call site for "time
+this, and profile the device while at it".
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Iterator, Optional
+
+from .registry import MetricsRegistry, default_registry
+
+__all__ = ["SpanTracer"]
+
+
+class SpanTracer:
+    """Named wall-clock spans recorded into a MetricsRegistry."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        self.registry = registry if registry is not None else default_registry()
+        self._hist = self.registry.histogram(
+            "cep_span_seconds", "Host wall per named span", labels=("span",)
+        )
+        self._count = self.registry.counter(
+            "cep_span_total", "Completed spans", labels=("span",)
+        )
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._hist.labels(span=name).observe(time.perf_counter() - t0)
+            self._count.labels(span=name).inc()
+
+    @contextlib.contextmanager
+    def device(self, log_dir: str, name: str = "device_trace") -> Iterator[Any]:
+        """Capture a device xplane profile of the block AND record its wall
+        as a span (the existing ops.profiling.device_trace, wrapped)."""
+        from ..ops.profiling import device_trace
+
+        with self.span(name):
+            with device_trace(log_dir):
+                yield

@@ -77,6 +77,16 @@ _I32_MAX = np.int64(2**31 - 1)
 #: `pend_min` sentinel: no pending match (any real node id is smaller).
 _PEND_MIN_NONE = np.int32(2**31 - 1)
 
+#: The observable per-key state counters every stats pull reduces (the
+#: `stats` / `shard_stats` / replay-handoff surfaces and the registry's
+#: cep_engine_state_counter gauges all iterate this one tuple -- add new
+#: engine counters here, not at the call sites). "runs" is state too but
+#: reported per key, not as a counter total.
+STATE_COUNTER_KEYS = (
+    "n_events", "n_branches", "n_expired",
+    "lane_drops", "node_drops", "match_drops", "seq_collisions",
+)
+
 
 @dataclass(frozen=True)
 class EngineConfig:

@@ -6,8 +6,18 @@ metrics; the framework-owned metrics here are the per-batch engine timings
 metric: time from `advance` dispatch to the drain that surfaced the match),
 and the engine counter totals (ops/engine.py state counters).
 
+Since ISSUE 5, BatchTimings is a CONSUMER of the obs registry
+(obs/registry.py) rather than a parallel bookkeeping path: every
+record_* call writes through the registry's counters and histograms (the
+exposition path -- prom text / JSON snapshot), and the ring buffer it
+keeps is only the bounded sample window for percentile summaries (the
+registry's histograms bucket cumulatively and never reset, per prom
+semantics; replacing a BatchTimings over the same registry resets the
+percentile window while the spine's counters stay monotonic).
+
 `device_trace` wraps `jax.profiler.trace` so a user can capture an xplane
-trace of the advance/GC programs without importing jax.profiler themselves.
+trace of the advance/GC programs without importing jax.profiler themselves
+(see also obs.SpanTracer.device, which records the capture wall as a span).
 """
 from __future__ import annotations
 
@@ -16,6 +26,14 @@ import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from ..obs.registry import MetricsRegistry
+
+#: Emit-latency-flavored buckets (seconds): the 500 ms contract sits
+#: mid-scale, with decade coverage on both sides.
+LATENCY_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+)
 
 
 class BatchTimings:
@@ -26,12 +44,63 @@ class BatchTimings:
     time); `drain_s` spans the blocking drain -- the only sync point -- so
     `advance dispatch -> drain return` is the match-emit latency an outside
     observer experiences.
+
+    `registry`: the obs spine to write through (a private registry is
+    created when none is given, so a standalone BatchTimings still
+    exposes). All registry instruments are get-or-create, so several
+    BatchTimings over one registry share the same counters.
     """
 
-    def __init__(self, capacity: int = 1024) -> None:
+    def __init__(
+        self,
+        capacity: int = 1024,
+        registry: Optional[MetricsRegistry] = None,
+    ) -> None:
         self.capacity = capacity
+        self.registry = registry if registry is not None else MetricsRegistry()
         self._records: List[Dict[str, float]] = []
         self._t_first_undrained: Optional[float] = None
+        r = self.registry
+        self._m_advance = r.histogram(
+            "cep_advance_dispatch_seconds",
+            "Host dispatch wall of the batched advance (async; not device time)",
+        )
+        self._m_post = r.histogram(
+            "cep_post_dispatch_seconds",
+            "Host dispatch wall of the per-advance post pass (append + GC)",
+        )
+        self._m_drain = r.histogram(
+            "cep_drain_seconds", "Blocking drain wall (the sync point)",
+        )
+        self._m_pull = r.histogram(
+            "cep_drain_pull_seconds",
+            "D2H transfer wall per drain (np.asarray-forced; PERF.md "
+            "'Measurement trap')",
+        )
+        self._m_decode = r.histogram(
+            "cep_decode_seconds", "Host match materialization wall per drain",
+        )
+        self._m_emit = r.histogram(
+            "cep_emit_latency_seconds",
+            "Match-emit latency: first undrained advance dispatch -> drain "
+            "return (BASELINE.md metric)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self._m_batches = r.counter("cep_batches_total", "Batches advanced")
+        self._m_drains = r.counter("cep_drains_total", "Drains performed")
+        self._m_slots = r.counter(
+            "cep_slots_total", "Dispatched [T, K] slots (padding included)",
+        )
+        self._m_matches = r.counter(
+            "cep_matches_total", "Matches surfaced by drains",
+        )
+        self._m_bytes = r.counter(
+            "cep_drain_bytes_total", "D2H bytes pulled by drains",
+        )
+        self._m_tunnel = r.gauge(
+            "cep_tunnel_mbps",
+            "Effective D2H tunnel rate of the latest byte-bearing drain",
+        )
 
     # ------------------------------------------------------------- recording
     def record_advance(
@@ -44,6 +113,10 @@ class BatchTimings:
         now = time.perf_counter()
         if self._t_first_undrained is None:
             self._t_first_undrained = now - seconds - post_s
+        self._m_advance.observe(seconds)
+        self._m_post.observe(post_s)
+        self._m_batches.inc()
+        self._m_slots.inc(slots)
         self._push(
             dict(
                 kind=0.0, seconds=seconds, slots=float(slots),
@@ -72,6 +145,16 @@ class BatchTimings:
             else seconds
         )
         self._t_first_undrained = None
+        self._m_drain.observe(seconds)
+        self._m_pull.observe(pull_s)
+        self._m_decode.observe(decode_s)
+        self._m_emit.observe(emit_latency)
+        self._m_drains.inc()
+        self._m_matches.inc(matches)
+        if bytes_pulled:
+            self._m_bytes.inc(bytes_pulled)
+            if pull_s > 0:
+                self._m_tunnel.set(bytes_pulled / pull_s / 1e6)
         self._push(
             dict(
                 kind=1.0, seconds=seconds, matches=float(matches),
@@ -103,14 +186,23 @@ class BatchTimings:
             "n": int(lat.size),
         }
 
+    #: components() keys -- always all present, whatever was recorded
+    #: (no-drain-yet, zero-match drains, profile_sync compute walls alike);
+    #: tunnel_mbps is None (never 0 or inf) until a drain pulled bytes.
+    COMPONENT_KEYS = (
+        "advance_ms", "post_ms", "drain_pull_ms", "decode_ms",
+        "drain_bytes", "tunnel_mbps",
+    )
+
     def components(self) -> Dict[str, Any]:
         """Per-component mean wall per batch/drain (ms) + effective tunnel
         rate: {advance, post, drain_pull, decode} plus `tunnel_mbps` =
         total pulled bytes / total D2H wall (None until a drain pulled
         data). advance/post are DISPATCH walls (sync-free advances
-        pipeline); drain_pull is D2H-forced (np.asarray) and so honest on
-        the axon tunnel, though dispatch->landed includes the flatten
-        pass's device time -- an upper bound on pure transfer."""
+        pipeline) unless the engine runs profile_sync=True, in which case
+        they are compute walls; drain_pull is D2H-forced (np.asarray) and
+        so honest on the axon tunnel, though dispatch->landed includes the
+        flatten pass's device time -- an upper bound on pure transfer."""
         adv = [r for r in self._records if r["kind"] == 0.0]
         dr = [r for r in self._records if r["kind"] == 1.0]
 
@@ -121,14 +213,19 @@ class BatchTimings:
                 np.mean([r.get(field, 0.0) for r in recs]) * 1e3
             )
 
-        total_bytes = sum(r.get("bytes", 0.0) for r in dr)
-        total_pull = sum(r.get("pull_s", 0.0) for r in dr)
+        total_bytes = float(sum(r.get("bytes", 0.0) for r in dr))
+        # Rate denominator: only byte-bearing drains' pull walls -- a
+        # probe-only drain (bytes == 0, tiny pull_s) would otherwise drag
+        # the effective rate below what the tunnel actually moved.
+        total_pull = float(
+            sum(r.get("pull_s", 0.0) for r in dr if r.get("bytes", 0.0) > 0)
+        )
         return {
             "advance_ms": mean_ms(adv, "seconds"),
             "post_ms": mean_ms(adv, "post_s"),
             "drain_pull_ms": mean_ms(dr, "pull_s"),
             "decode_ms": mean_ms(dr, "decode_s"),
-            "drain_bytes": float(total_bytes),
+            "drain_bytes": total_bytes,
             "tunnel_mbps": (
                 float(total_bytes / total_pull / 1e6)
                 if total_pull > 0 and total_bytes > 0

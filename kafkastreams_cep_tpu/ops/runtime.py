@@ -30,6 +30,7 @@ from ..pattern.stages import Stages
 import jax
 
 from .engine import (
+    STATE_COUNTER_KEYS,
     WINDOW_PLANES,
     EngineConfig,
     build_append_post,
@@ -63,12 +64,25 @@ class DeviceNFA:
         config: Optional[EngineConfig] = None,
         events_prune_threshold: int = 1 << 16,
         exact_replay: bool = True,
+        registry: Optional[Any] = None,
     ) -> None:
         if isinstance(stages_or_query, CompiledQuery):
             self.query = stages_or_query
         else:
             assert isinstance(stages_or_query, Stages)
             self.query = compile_query(stages_or_query, schema)
+        from ..obs.registry import MetricsRegistry, next_instance_id
+
+        # Single-key engines share the batched driver's gauge naming; the
+        # registry is private unless one is passed (see parallel/batched.py).
+        self.metrics = registry if registry is not None else MetricsRegistry()
+        self.instance_id = next_instance_id()
+        self._m_state = self.metrics.gauge(
+            "cep_engine_state_counter",
+            "Engine state counter totals from the last stats pull "
+            "(updated on the explicit stats sync, never on the advance path)",
+            labels=("instance", "counter"),
+        )
         self.config = config if config is not None else EngineConfig()
         self._advance = build_batch_fn(self.query, self.config)
         self._append_post = jax.jit(build_append_post(self.config))
@@ -117,11 +131,12 @@ class DeviceNFA:
 
     @property
     def stats(self) -> Dict[str, int]:
-        keys = (
-            "n_events", "n_branches", "n_expired",
-            "lane_drops", "node_drops", "match_drops", "seq_collisions",
-        )
-        return {k: int(self.state[k]) for k in keys}
+        out = {k: int(self.state[k]) for k in STATE_COUNTER_KEYS}
+        # Registry gauges piggyback on this explicit pull (the advance path
+        # never syncs for telemetry).
+        for k, v in out.items():
+            self._m_state.labels(instance=self.instance_id, counter=k).set(v)
+        return out
 
     def match_pattern(self, event: Event) -> List[Sequence]:
         """Single-event convenience API mirroring NFA.match_pattern."""
@@ -295,10 +310,7 @@ class DeviceNFA:
             return engine_matches
         counters = {
             k: np.asarray(self.state[k])
-            for k in (
-                "n_events", "n_branches", "n_expired",
-                "lane_drops", "node_drops", "match_drops", "seq_collisions",
-            )
+            for k in STATE_COUNTER_KEYS
         }
         try:
             new_state, new_pool = oracle_to_device(

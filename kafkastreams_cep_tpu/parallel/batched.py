@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from ..core.event import Event
 from ..core.sequence import Sequence
 from ..ops.engine import (
+    STATE_COUNTER_KEYS,
     WINDOW_PLANES,
     EngineConfig,
     drain_pend,
@@ -94,6 +95,7 @@ class BatchedDeviceNFA:
         drain_mode: str = "flat",
         target_emit_ms: Optional[float] = None,
         profile_sync: bool = False,
+        registry: Optional[Any] = None,
     ) -> None:
         if drain_mode not in ("flat", "pool"):
             raise ValueError(f"unknown drain_mode {drain_mode!r}")
@@ -199,6 +201,7 @@ class BatchedDeviceNFA:
         self._pos_obs: Optional[Tuple[int, int, int]] = None
         self._drain_epoch = 0
         self._pos_max_fn = None
+        self._shard_stats_fn = None
         self._drain_compact_fn = None
         self._drain_counts_fn = None
         self._compact_pend_fn = None
@@ -257,11 +260,107 @@ class BatchedDeviceNFA:
         self._interval_overflow = False
         self._pack_meta: deque = deque()
         self._collision_base = np.zeros(self.K_padded, np.int64)
+        from ..obs.registry import MetricsRegistry
         from ..ops.profiling import BatchTimings
 
+        #: The engine's metrics spine (obs/registry.py): PRIVATE by default
+        #: -- instance gauges (pend occupancy, gc phase) from two engines
+        #: would fight over one time series in a shared registry; pass
+        #: `registry=` to aggregate deliberately. Every update on the
+        #: advance path uses host-resident values only (the zero-extra-
+        #: device-syncs contract, pinned by tests/test_obs.py); device-side
+        #: telemetry piggybacks on the fused [3, K] drain probe, the async
+        #: ring probes and the explicit `stats` pull.
+        self.metrics: MetricsRegistry = (
+            registry if registry is not None else MetricsRegistry()
+        )
         #: Per-batch dispatch/drain timings + match-emit latency histogram
-        #: (SURVEY.md §5.5; semantics in ops/profiling.py).
-        self.timings = BatchTimings()
+        #: (SURVEY.md §5.5; semantics in ops/profiling.py) -- a registry
+        #: consumer: replacing it resets the percentile window, the spine's
+        #: counters stay monotonic.
+        self.timings = BatchTimings(registry=self.metrics)
+        self._init_metrics()
+
+    def _init_metrics(self) -> None:
+        """Register the engine-level instruments on `self.metrics`.
+
+        Per-instance GAUGES carry an `instance` label (bound once here, so
+        the hot-path call sites see a plain child): two engines sharing one
+        registry must never interleave one series. Counters stay unlabeled
+        -- monotonic totals merge correctly across instances."""
+        from ..obs.registry import next_instance_id
+
+        r = self.metrics
+        self.instance_id = next_instance_id()
+        inst = self.instance_id
+        self._m_info = r.gauge(
+            "cep_engine_info",
+            "Engine identity (value 1; labels carry the resolved config)",
+            labels=("instance", "engine", "drain_mode"),
+        )
+        self._m_info.labels(
+            instance=inst, engine=self.engine, drain_mode=self.drain_mode
+        ).set(1)
+        self._m_fallback = r.gauge(
+            "cep_engine_fallback",
+            "1 while the auto-selected engine fell back (reason label); "
+            "stays visible after the one-shot warning",
+            labels=("instance", "reason"),
+        )
+        if self.engine_fallback_reason is not None:
+            self._m_fallback.labels(
+                instance=inst, reason=self.engine_fallback_reason
+            ).set(1)
+        self._m_gc_phase = r.gauge(
+            "cep_gc_phase", "Advances accumulated since the last group flush",
+            labels=("instance",),
+        ).labels(instance=inst)
+        self._m_flushes = r.counter(
+            "cep_gc_flushes_total", "GC group flushes (mark/sweep passes)",
+        )
+        self._m_auto_drains = r.counter(
+            "cep_auto_drains_total",
+            "Engine-initiated ring pulls by trigger "
+            "(ring_full | region_pressure | micro_drain)",
+            labels=("trigger",),
+        )
+        self._m_pend_occupancy = r.gauge(
+            "cep_pend_occupancy",
+            "Freshest probed max ring cursor (true pending-match count)",
+            labels=("instance",),
+        ).labels(instance=inst)
+        self._m_region_fill = r.gauge(
+            "cep_region_fill", "Freshest probed max node-region fill",
+            labels=("instance",),
+        ).labels(instance=inst)
+        self._m_pending = r.gauge(
+            "cep_pending_matches", "Pending matches at the last drain probe",
+            labels=("instance",),
+        ).labels(instance=inst)
+        self._m_chain_depth = r.gauge(
+            "cep_chain_depth_max", "Max chain depth at the last flat drain probe",
+            labels=("instance",),
+        ).labels(instance=inst)
+        self._m_ledger_overflow = r.gauge(
+            "cep_replay_ledger_overflow",
+            "1 while the exact-replay event ledger overflowed this interval",
+            labels=("instance",),
+        ).labels(instance=inst)
+        self._m_divergence = r.gauge(
+            "cep_fold_divergence_detected",
+            "1 once fold divergence was detected with replay unavailable "
+            "(persists after the one-shot warning)",
+            labels=("instance",),
+        ).labels(instance=inst)
+        self._m_replays = r.counter(
+            "cep_replays_total", "Per-key oracle replays at drain boundaries",
+        )
+        self._m_state = r.gauge(
+            "cep_engine_state_counter",
+            "Engine state counter totals from the last stats pull "
+            "(updated on the explicit stats sync, never on the advance path)",
+            labels=("instance", "counter"),
+        )
 
     def _pick_engine(self, engine: str) -> Tuple[str, Optional[str]]:
         """Resolve "auto" to the fused pallas kernel when it applies.
@@ -352,17 +451,54 @@ class BatchedDeviceNFA:
     @property
     def stats(self) -> Dict[str, int]:
         """Cross-key counter totals: one fused reduction + one host pull
-        (key_shard.global_stats; an ICI all-reduce when sharded)."""
+        (key_shard.global_stats; an ICI all-reduce when sharded).
+
+        The pull is an explicit sync the caller opted into; the registry's
+        `cep_engine_state_counter` gauges piggyback on it (device counters
+        never reach the registry from the zero-sync advance path)."""
         from .key_shard import global_stats
 
         if self._stats_fn is None:
             self._stats_fn = jax.jit(global_stats)
         pulled = jax.device_get(self._stats_fn(self.state))
-        keys = (
-            "n_events", "n_branches", "n_expired",
-            "lane_drops", "node_drops", "match_drops", "seq_collisions",
+        out = {k: int(pulled[k]) for k in STATE_COUNTER_KEYS}
+        for k, v in out.items():
+            self._m_state.labels(instance=self.instance_id, counter=k).set(v)
+        return out
+
+    def shard_stats(self) -> Dict[str, np.ndarray]:
+        """Per-shard counter totals ([n_shards] per counter) -- one fused
+        reduction + one host pull, like `stats` but resolved per mesh
+        shard (contiguous key blocks; shard 0 is the whole engine on an
+        unsharded key axis). An explicit sync; the registry's
+        `cep_shard_state_counter{counter, shard}` gauges piggyback on it.
+        Cross-mesh merging of per-device registries is deferred (see
+        ROADMAP "Open items")."""
+        from .key_shard import shard_stats
+
+        n_shards = 1
+        if self.mesh is not None:
+            n_shards = int(
+                np.prod([self.mesh.shape[a] for a in self.mesh.axis_names])
+            )
+        if self._shard_stats_fn is None:
+            import functools
+
+            self._shard_stats_fn = jax.jit(
+                functools.partial(shard_stats, n_shards=n_shards)
+            )
+        pulled = jax.device_get(self._shard_stats_fn(self.state))
+        gauge = self.metrics.gauge(
+            "cep_shard_state_counter",
+            "Engine state counter totals per mesh shard (explicit pull)",
+            labels=("instance", "counter", "shard"),
         )
-        return {k: int(pulled[k]) for k in keys}
+        for name, arr in pulled.items():
+            for s in range(arr.shape[0]):
+                gauge.labels(
+                    instance=self.instance_id, counter=name, shard=str(s)
+                ).set(int(arr[s]))
+        return {k: np.asarray(v) for k, v in pulled.items()}
 
     def runs(self, key: Any) -> int:
         return int(np.asarray(self.state["runs"])[self.key_index[key]])
@@ -552,6 +688,9 @@ class BatchedDeviceNFA:
                 # their own drain only runs after the advance appended to
                 # the ring.
                 ring_full = occ + step_cap > self.config.matches
+                self._m_auto_drains.labels(
+                    trigger="ring_full" if ring_full else "region_pressure"
+                ).inc()
                 raw = self._pull_raw()
                 if raw is not None:
                     self._submit_decode(raw)
@@ -588,6 +727,9 @@ class BatchedDeviceNFA:
                         RuntimeWarning,
                     )
                 self._interval_overflow = True
+                # Persistent gauge: the condition stays visible after the
+                # one-shot warning (cleared at the next replay boundary).
+                self._m_ledger_overflow.set(1)
                 self._interval_packs = []
             else:
                 self._interval_packs.append(entry)
@@ -612,11 +754,27 @@ class BatchedDeviceNFA:
             # any state was mutated): fall back to the XLA scan step.
             import warnings
 
+            # Retire the old identity series before claiming the new one:
+            # a scraper keyed on cep_engine_info==1 must see exactly one
+            # current identity per instance.
+            self._m_info.labels(
+                instance=self.instance_id,
+                engine=self.engine, drain_mode=self.drain_mode,
+            ).set(0)
             self.engine = "xla"
             self.engine_fallback_reason = (
                 f"pallas kernel failed, fell back to xla: {exc}"[:300]
             )
             warnings.warn(self.engine_fallback_reason)
+            # Keep the first-hit warning above; the gauge keeps the
+            # condition visible for the engine's lifetime.
+            self._m_fallback.labels(
+                instance=self.instance_id, reason=self.engine_fallback_reason
+            ).set(1)
+            self._m_info.labels(
+                instance=self.instance_id,
+                engine=self.engine, drain_mode=self.drain_mode,
+            ).set(1)
             self._advance = build_batched_advance(self.query, self.config)
             self._append = build_batched_append(self.config)
             self._flush = build_batched_flush(self.query, self.config)
@@ -635,6 +793,9 @@ class BatchedDeviceNFA:
         self._group_roots.append(page_roots)
         if len(self._group_ys) >= self.gc_group:
             self._flush_group()
+        # Host-side group phase (== the device gc_phase scalar by
+        # construction): no pull needed.
+        self._m_gc_phase.set(len(self._group_ys))
         if self.profile_sync:
             jax.block_until_ready((self.state, self.pool))
         self._batches += 1
@@ -673,6 +834,7 @@ class BatchedDeviceNFA:
             # probe-silent after at most two no-op pulls.
             _, _, probed_pos = self._occupancy_bound()
             if probed_pos is None or probed_pos > 0:
+                self._m_auto_drains.labels(trigger="micro_drain").inc()
                 raw = self._pull_raw()
                 if raw is not None:
                     self._submit_decode(raw)
@@ -721,6 +883,9 @@ class BatchedDeviceNFA:
                 from ..ops.replay import supports_replay
 
                 self._warned_collisions = True
+                # Persistent gauge alongside the one-shot warning: the
+                # divergence stays visible for the engine's lifetime.
+                self._m_divergence.set(1)
                 if supports_replay(self.query):
                     remedy = (
                         "Re-enable exact_replay (default) to recover "
@@ -770,6 +935,10 @@ class BatchedDeviceNFA:
 
         cur = np.asarray(self.state["seq_collisions"]).astype(np.int64)
         hot = np.nonzero(cur > self._collision_base[: cur.shape[0]])[0]
+        if hot.size:
+            # Divergence observed (replay will recover it when the ledger
+            # held): keep it visible beyond the warning.
+            self._m_divergence.set(1)
         if hot.size and self._interval_overflow:
             import warnings
 
@@ -784,10 +953,7 @@ class BatchedDeviceNFA:
 
             snap_state, snap_pool = self._snap
             ts_base = self._ts_base if self._ts_base is not None else 0
-            counter_names = (
-                "n_events", "n_branches", "n_expired", "lane_drops",
-                "node_drops", "match_drops", "seq_collisions",
-            )
+            counter_names = STATE_COUNTER_KEYS
             for k in hot.tolist():
                 if k >= len(self.keys):
                     continue  # padding lanes never see valid events
@@ -828,6 +994,7 @@ class BatchedDeviceNFA:
                     )
                     continue
                 self.replays += 1
+                self._m_replays.inc()
                 if matches:
                     out[key] = matches
                 else:
@@ -851,6 +1018,7 @@ class BatchedDeviceNFA:
         self._snap = (self.state, self.pool)
         self._interval_packs = []
         self._interval_overflow = False
+        self._m_ledger_overflow.set(0)
         return out
 
     def _write_key_state(
@@ -1035,6 +1203,10 @@ class BatchedDeviceNFA:
             if epoch == self._drain_epoch:
                 vals = np.asarray(arr)
                 self._pos_obs = (acc, int(vals[0]), int(vals[1]))
+                # Device occupancy telemetry rides the probe that already
+                # landed -- no extra sync.
+                self._m_pend_occupancy.set(int(vals[0]))
+                self._m_region_fill.set(int(vals[1]))
                 if int(vals[0]) > 0:
                     # A real match landed: re-arm the region-pressure
                     # trigger (see advance_packed's backoff).
@@ -1080,6 +1252,8 @@ class BatchedDeviceNFA:
             self.state, self.pool, ys_cat, roots_cat
         )
         self.flushes += 1
+        self._m_flushes.inc()
+        self._m_gc_phase.set(0)
 
     def _drain_compact(self):
         """The jitted drain-side compactor: walk the PRECISE pend-reachable
@@ -1265,6 +1439,11 @@ class BatchedDeviceNFA:
         probe = np.asarray(self._drain_probe_fn(pool_view))  # the one sync
         counts = probe[0]
         self.last_match_counts = counts
+        # Drain-probe telemetry piggybacks on the fused [3, K] pull the
+        # drain performs anyway (counts, cursors, depth bound).
+        self._m_pending.set(int(counts.sum()))
+        self._m_pend_occupancy.set(int(probe[1].max()))
+        self._m_chain_depth.set(int(probe[2].max()))
         if counts.sum() == 0:
             if int(probe[1].max()) > 0:
                 self.pool = self._drain_pend(self.pool)  # reclaim cursor
@@ -1330,6 +1509,9 @@ class BatchedDeviceNFA:
         both = np.asarray(self._drain_counts_fn(self.pool))
         counts = both[0]
         self.last_match_counts = counts
+        # Piggyback on the [2, K] probe the pool drain already pulls.
+        self._m_pending.set(int(counts.sum()))
+        self._m_pend_occupancy.set(int(both[1].max()))
         if counts.sum() == 0:
             if int(both[1].max()) > 0:
                 self.pool = self._drain_pend(self.pool)  # reclaim cursor

@@ -23,7 +23,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.engine import EngineConfig, build_step, init_pool, init_state
+from ..ops.engine import (
+    STATE_COUNTER_KEYS,
+    EngineConfig,
+    build_step,
+    init_pool,
+    init_state,
+)
 from ..ops.tables import CompiledQuery
 
 #: Mesh axis name for the key shard (data-parallel axis).
@@ -194,9 +200,30 @@ def global_stats(state: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
     Under a sharded key axis XLA lowers these sums to an all-reduce over ICI
     (SURVEY.md section 5.5 observability counters).
     """
-    keys = (
-        "n_events", "n_branches", "n_expired",
-        "lane_drops", "node_drops", "match_drops", "seq_collisions",
-        "runs",
-    )
+    keys = STATE_COUNTER_KEYS + ("runs",)
     return {k: jnp.sum(state[k]) for k in keys}
+
+
+def shard_stats(
+    state: Dict[str, jnp.ndarray], n_shards: int = 1
+) -> Dict[str, jnp.ndarray]:
+    """Per-shard counter reduction: [K] counters summed within each of the
+    `n_shards` contiguous key blocks (the mesh's block partitioning of the
+    trailing key axis), giving [n_shards] totals per counter.
+
+    This is the observability aggregation point for the obs registry's
+    per-shard gauges (BatchedDeviceNFA.shard_stats): under a sharded key
+    axis each block sum stays device-local and only the tiny [n_shards]
+    result crosses ICI at the pull -- the per-event hot path still carries
+    no collectives (SURVEY.md section 2.8/5.5)."""
+    keys = STATE_COUNTER_KEYS + ("runs",)
+
+    def per_shard(leaf: jnp.ndarray) -> jnp.ndarray:
+        k = leaf.shape[-1]
+        if k % n_shards:
+            raise ValueError(
+                f"key extent {k} not divisible by {n_shards} shards"
+            )
+        return jnp.sum(leaf.reshape(n_shards, k // n_shards), axis=-1)
+
+    return {k: per_shard(state[k]) for k in keys}

@@ -71,6 +71,10 @@ class QueryNode(Generic[K, V]):
         self.runtime = runtime
         self.downstream: List[Callable] = []
         self.sink_topics: List[str] = []
+        # The obs registry rides both runtimes (one telemetry spine per
+        # topology when the caller passes one); the rest of device_opts is
+        # tpu-only engine tuning.
+        registry = device_opts.pop("registry", None)
         if runtime == "tpu":
             from .device_processor import DeviceCEPProcessor
 
@@ -80,6 +84,7 @@ class QueryNode(Generic[K, V]):
                 name,
                 pattern,
                 schema=queried.schema if queried is not None else None,
+                registry=registry,
                 **device_opts,
             )
             return
@@ -95,6 +100,7 @@ class QueryNode(Generic[K, V]):
             nfa_store=self.stores[nfa_states_store(name)],
             buffer=self.stores[event_buffer_store(name)],
             aggregates=self.stores[aggregates_store(name)],
+            registry=registry,
         )
 
 

@@ -48,6 +48,7 @@ class DeviceCEPProcessor(Generic[K, V]):
         batch_size: int = 64,
         initial_keys: int = 8,
         mesh: Optional[Any] = None,
+        registry: Optional[Any] = None,
     ) -> None:
         if isinstance(pattern_or_query, CompiledQuery):
             self.query = pattern_or_query
@@ -59,12 +60,27 @@ class DeviceCEPProcessor(Generic[K, V]):
         self.config = config if config is not None else EngineConfig()
         self.batch_size = max(1, batch_size)
         self._capacity = max(1, initial_keys)
+        # `registry` flows into the engine, so the device driver and its
+        # engine share one spine; per-query stream counters ride the same
+        # registry under the query label.
         self.engine = BatchedDeviceNFA(
             self.query,
             keys=[_Lane(i) for i in range(self._capacity)],
             config=self.config,
             mesh=mesh,
+            registry=registry,
         )
+        self.metrics = self.engine.metrics
+        self._m_flushes = self.metrics.counter(
+            "cep_device_processor_flushes_total",
+            "Micro-batch flushes through the device engine",
+            labels=("query",),
+        ).labels(query=self.query_name)
+        self._m_matches = self.metrics.counter(
+            "cep_device_processor_matches_total",
+            "Sequences emitted by the device driver",
+            labels=("query",),
+        ).labels(query=self.query_name)
         self._lane_of_key: Dict[Any, _Lane] = {}
         self._next_lane = 0
         self._pending: Dict[Any, List[Event]] = {}
@@ -136,6 +152,9 @@ class DeviceCEPProcessor(Generic[K, V]):
         out: List[Tuple[K, Sequence]] = []
         for lane, seqs in self.engine.advance(batch).items():
             out.extend((lane.key, s) for s in seqs)
+        self._m_flushes.inc()
+        if out:
+            self._m_matches.inc(len(out))
         return out
 
     def runs(self, key: K) -> int:

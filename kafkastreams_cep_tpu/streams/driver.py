@@ -14,8 +14,10 @@ Records in source topics carry pickled keys/values by default; pass
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..obs.registry import MetricsRegistry, default_registry
 from ..state.store import default_deserializer, default_serializer
 from .builder import Topology
 from .log import RecordLog
@@ -42,7 +44,15 @@ def produce(
 
 
 class LogDriver:
-    """Drives one topology from a RecordLog: restore, poll, commit."""
+    """Drives one topology from a RecordLog: restore, poll, commit.
+
+    The Kafka-Streams-metrics surface the reference delegates to the
+    framework lives here too: poll/record/commit counters and the restore
+    wall land in `registry` (the process default when none is passed).
+    `report_every_s` arms a periodic reporter: after a poll, once the
+    interval has elapsed since the last report, `reporter` is called with
+    the registry's prom-text exposition (default: the
+    `kafkastreams_cep_tpu.obs` logger at INFO)."""
 
     def __init__(
         self,
@@ -52,6 +62,9 @@ class LogDriver:
         key_deserializer: Callable[[bytes], Any] = default_deserializer,
         value_deserializer: Callable[[bytes], Any] = default_deserializer,
         restore: bool = True,
+        registry: Optional[MetricsRegistry] = None,
+        report_every_s: Optional[float] = None,
+        reporter: Optional[Callable[[str], None]] = None,
     ) -> None:
         self.topology = topology
         self.log = log if log is not None else topology.log
@@ -60,6 +73,35 @@ class LogDriver:
         self.group = group
         self.key_de = key_deserializer
         self.value_de = value_deserializer
+        self.metrics = registry if registry is not None else default_registry()
+        # Children bound once to this driver's group (labels() locks per
+        # resolution; poll() is the cadence path).
+        self._m_polls = self.metrics.counter(
+            "cep_driver_polls_total", "poll() calls", labels=("group",)
+        ).labels(group=self.group)
+        self._m_records = self.metrics.counter(
+            "cep_driver_records_total", "Records polled and processed",
+            labels=("group",),
+        ).labels(group=self.group)
+        self._m_commits = self.metrics.counter(
+            "cep_driver_commits_total", "Offset commits (dirty positions only)",
+            labels=("group",),
+        ).labels(group=self.group)
+        self._m_restore_s = self.metrics.gauge(
+            "cep_driver_restore_seconds", "Changelog restore wall at startup",
+            labels=("group",),
+        ).labels(group=self.group)
+        self._m_restored = self.metrics.gauge(
+            "cep_driver_restored_records", "Changelog records replayed at startup",
+            labels=("group",),
+        ).labels(group=self.group)
+        self._m_reports = self.metrics.counter(
+            "cep_driver_reports_total", "Periodic metric reports emitted",
+            labels=("group",),
+        ).labels(group=self.group)
+        self.report_every_s = report_every_s
+        self.reporter = reporter
+        self._last_report_t = time.perf_counter()
         self._positions: Dict[Tuple[str, int], int] = {}
         #: positions as last durably committed -- commit() appends only the
         #: deltas, so the offsets topic grows with progress, not with the
@@ -67,7 +109,10 @@ class LogDriver:
         self._committed: Dict[Tuple[str, int], int] = {}
         self.restored_records = 0
         if restore:
+            t0 = time.perf_counter()
             self.restored_records = self.topology.restore_stores()
+            self._m_restore_s.set(time.perf_counter() - t0)
+            self._m_restored.set(self.restored_records)
         self._load_committed()
 
     # ------------------------------------------------------------- offsets
@@ -109,6 +154,7 @@ class LogDriver:
             )
         self.log.flush()
         self._committed.update(dirty)
+        self._m_commits.inc()
 
     def position(self, topic: str, partition: int = 0) -> int:
         return self._positions.get((topic, partition), 0)
@@ -145,4 +191,37 @@ class LogDriver:
         self.topology.flush()  # flush device micro-batches
         if commit and processed:
             self.commit()
+        self._m_polls.inc()
+        self._m_records.inc(processed)
+        self._maybe_report()
         return processed
+
+    # ---------------------------------------------------------- reporting
+    def _maybe_report(self) -> None:
+        """Periodic reporter hook: emit the registry's prom-text exposition
+        once `report_every_s` has elapsed since the last report (checked
+        after each poll -- the driver's natural cadence point)."""
+        if self.report_every_s is None:
+            return
+        now = time.perf_counter()
+        if now - self._last_report_t < self.report_every_s:
+            return
+        self._last_report_t = now
+        import logging
+
+        # Best-effort: a failing reporter (push gateway blip) must never
+        # break the data path -- records were already processed and
+        # offsets committed by the time we get here.
+        try:
+            text = self.metrics.to_prom_text()
+            if self.reporter is not None:
+                self.reporter(text)
+            else:
+                logging.getLogger("kafkastreams_cep_tpu.obs").info(
+                    "metrics report (group=%s)\n%s", self.group, text
+                )
+            self._m_reports.inc()
+        except Exception:
+            logging.getLogger("kafkastreams_cep_tpu.obs").warning(
+                "metrics reporter failed (group=%s)", self.group, exc_info=True
+            )

@@ -40,7 +40,10 @@ class CEPProcessor(Generic[K, V]):
         buffer: Optional[BufferStore] = None,
         aggregates: Optional[AggregatesStore] = None,
         strict_windows: bool = False,
+        registry: Optional[Any] = None,
     ) -> None:
+        from ..obs.registry import default_registry
+
         self.stages: Stages = ensure_stages(pattern_or_stages)
         self.query_name = normalize_query_name(query_name)
         self.nfa_store = nfa_store if nfa_store is not None else NFAStore()
@@ -49,6 +52,27 @@ class CEPProcessor(Generic[K, V]):
         # See NFA(strict_windows=...): False = reference window parity,
         # True = epsilon stages inherit windows (bounded-memory mode).
         self.strict_windows = strict_windows
+        # Per-query stream counters (labels bounded by the query count):
+        # the always-on host-path telemetry, in the process default
+        # registry unless one is passed.
+        self.metrics = registry if registry is not None else default_registry()
+        # Children bound once: labels() takes a lock per resolution, and
+        # this is the per-record hot path (also the vs_baseline denominator).
+        self._m_records = self.metrics.counter(
+            "cep_processor_records_total",
+            "Records processed by the host per-record driver",
+            labels=("query",),
+        ).labels(query=self.query_name)
+        self._m_matches = self.metrics.counter(
+            "cep_processor_matches_total",
+            "Completed sequences emitted by the host per-record driver",
+            labels=("query",),
+        ).labels(query=self.query_name)
+        self._m_skipped = self.metrics.counter(
+            "cep_processor_skipped_total",
+            "Records skipped below the high-water mark (at-least-once dedup)",
+            labels=("query",),
+        ).labels(query=self.query_name)
 
     def _load_nfa(self, key: K) -> Tuple[NFA, NFAStates]:
         snapshot = self.nfa_store.find(key)
@@ -90,10 +114,14 @@ class CEPProcessor(Generic[K, V]):
         latest = snapshot.latest_offset_for_topic(hwm_key)
         if latest is not None and offset < latest:
             # Replayed record below the high-water mark: at-least-once dedup.
+            self._m_skipped.inc()
             return []
 
         event = Event(key, value, timestamp, topic, partition, offset)
         sequences = nfa.match_pattern(event)
+        self._m_records.inc()
+        if sequences:
+            self._m_matches.inc(len(sequences))
 
         offsets = dict(snapshot.latest_offsets)
         offsets[hwm_key] = offset + 1

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Validate a bench.py JSON artifact against the documented schema.
+
+BENCH_r*.json artifacts must stay self-describing (PERF.md "v10 metrics
+dictionary" documents every key): this checker fails on BOTH missing
+documented keys AND undocumented extras, so a bench change that grows or
+renames the JSON contract must update the dictionary (and this schema) in
+the same PR. It also proves the two metric expositions agree: the
+`metrics` section (the engine registry's JSON snapshot) is rebuilt into a
+registry, rendered as Prometheus 0.0.4 text, parsed back, and compared
+value-for-value.
+
+Usage:
+    python scripts/check_bench_schema.py BENCH.json   # or - for stdin
+bench.py --smoke runs validate() on its own output before printing.
+"""
+from __future__ import annotations
+
+import json
+import math
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+NUMBER = (int, float)
+OPT_NUMBER = (int, float, type(None))
+
+#: Top-level contract: key -> (required, allowed types). Every key bench
+#: emits must appear here; every required key must be present in the
+#: artifact. One line per key in PERF.md "v10 metrics dictionary".
+TOP_LEVEL: Dict[str, Tuple[bool, tuple]] = {
+    "metric": (True, (str,)),
+    "value": (True, NUMBER),
+    "unit": (True, (str,)),
+    "vs_baseline": (True, OPT_NUMBER),
+    "p99_match_emit_ms": (True, OPT_NUMBER),
+    "components": (True, (dict, type(None))),
+    "tunnel_mbps": (True, OPT_NUMBER),
+    "tunnel_degraded": (True, (bool,)),
+    "latency_p99_match_emit_ms": (True, OPT_NUMBER),
+    "platform": (True, (str,)),
+    "quick": (True, (bool,)),
+    "denominator": (True, (str,)),
+    "configs": (True, (dict,)),
+    "metrics": (True, (dict,)),
+    "schema_ok": (False, (bool,)),
+}
+
+#: The per-component breakdown (ops/profiling.py BatchTimings.components):
+#: all keys always present; tunnel_mbps None until a drain pulled bytes.
+COMPONENT_KEYS: Dict[str, tuple] = {
+    "advance_ms": NUMBER,
+    "post_ms": NUMBER,
+    "drain_pull_ms": NUMBER,
+    "decode_ms": NUMBER,
+    "drain_bytes": NUMBER,
+    "tunnel_mbps": OPT_NUMBER,
+}
+
+METRIC_KINDS = ("counter", "gauge", "histogram")
+
+
+def _check_components(c: Optional[dict], where: str, errors: List[str]) -> None:
+    if c is None:
+        return
+    for k, types in COMPONENT_KEYS.items():
+        if k not in c:
+            errors.append(f"{where}: missing component key {k!r}")
+        elif not isinstance(c[k], types):
+            errors.append(
+                f"{where}.{k}: expected {types}, got {type(c[k]).__name__}"
+            )
+    for k in c:
+        if k not in COMPONENT_KEYS:
+            errors.append(f"{where}: undocumented component key {k!r}")
+
+
+def _check_metrics_section(snap: dict, errors: List[str]) -> None:
+    """Structural check of a registry snapshot + prom-text round-trip."""
+    # Section-local structural errors gate the round-trip below (a
+    # malformed snapshot cannot be rebuilt); unrelated errors from other
+    # sections must not suppress this check.
+    local: List[str] = []
+    for name, fam in snap.items():
+        where = f"metrics.{name}"
+        if not isinstance(fam, dict):
+            local.append(f"{where}: expected object")
+            continue
+        kind = fam.get("type")
+        if kind not in METRIC_KINDS:
+            local.append(f"{where}: bad type {kind!r}")
+            continue
+        for req in ("help", "label_names", "values"):
+            if req not in fam:
+                local.append(f"{where}: missing {req!r}")
+        for entry in fam.get("values", ()):
+            if kind == "histogram":
+                missing = {"labels", "count", "sum", "buckets"} - set(entry)
+            else:
+                missing = {"labels", "value"} - set(entry)
+            if missing:
+                local.append(f"{where}: value entry missing {sorted(missing)}")
+    errors.extend(local)
+    if local:
+        return
+    # Round-trip: snapshot -> registry -> prom text -> parsed samples must
+    # carry the same values the snapshot holds.
+    try:
+        from kafkastreams_cep_tpu.obs.registry import (
+            parse_prom_text,
+            registry_from_snapshot,
+        )
+    except Exception as exc:  # pragma: no cover - missing package on PATH
+        errors.append(f"metrics: cannot import obs registry ({exc})")
+        return
+    reg = registry_from_snapshot(snap)
+    parsed = parse_prom_text(reg.to_prom_text())
+
+    def close(a: float, b: float) -> bool:
+        if math.isinf(a) or math.isinf(b):
+            return a == b
+        return math.isclose(a, b, rel_tol=1e-9, abs_tol=1e-12)
+
+    for name, fam in snap.items():
+        label_names = fam.get("label_names", [])
+        for entry in fam["values"]:
+            base = tuple(
+                (ln, str(entry["labels"][ln])) for ln in label_names
+            )
+            if fam["type"] == "histogram":
+                pairs = [
+                    (f"{name}_sum", base, float(entry["sum"])),
+                    (f"{name}_count", base, float(entry["count"])),
+                ] + [
+                    (
+                        f"{name}_bucket",
+                        base + (("le", le),),
+                        float(cum),
+                    )
+                    for le, cum in entry["buckets"].items()
+                ]
+            else:
+                pairs = [(name, base, float(entry["value"]))]
+            for sample, labels, want in pairs:
+                got = parsed.get(sample, {}).get(labels)
+                if got is None:
+                    errors.append(
+                        f"metrics round-trip: {sample}{dict(labels)} "
+                        "missing from prom text"
+                    )
+                elif not close(got, want):
+                    errors.append(
+                        f"metrics round-trip: {sample}{dict(labels)} "
+                        f"prom={got} snapshot={want}"
+                    )
+
+
+def validate(out: Any) -> List[str]:
+    """Return a list of schema violations (empty = valid)."""
+    errors: List[str] = []
+    if not isinstance(out, dict):
+        return [f"artifact must be a JSON object, got {type(out).__name__}"]
+    for key, (required, types) in TOP_LEVEL.items():
+        if key not in out:
+            if required:
+                errors.append(f"missing documented key {key!r}")
+            continue
+        if not isinstance(out[key], types):
+            errors.append(
+                f"{key}: expected {tuple(t.__name__ for t in types)}, "
+                f"got {type(out[key]).__name__}"
+            )
+    for key in out:
+        if key not in TOP_LEVEL:
+            errors.append(
+                f"undocumented key {key!r} (document it in PERF.md's "
+                "metrics dictionary and scripts/check_bench_schema.py)"
+            )
+    if isinstance(out.get("components"), (dict, type(None))):
+        _check_components(out.get("components"), "components", errors)
+    configs = out.get("configs")
+    if isinstance(configs, dict):
+        for name, cfg in configs.items():
+            if not isinstance(cfg, dict):
+                errors.append(f"configs.{name}: expected object")
+            elif isinstance(cfg.get("components"), dict):
+                _check_components(
+                    cfg["components"], f"configs.{name}.components", errors
+                )
+    if isinstance(out.get("metrics"), dict):
+        _check_metrics_section(out["metrics"], errors)
+    return errors
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    if argv[1] == "-":
+        text = sys.stdin.read()
+    else:
+        with open(argv[1]) as f:
+            text = f.read()
+    # bench.py prints exactly one JSON line on stdout, but a captured log
+    # may carry stderr noise: take the last line that parses as an object.
+    doc = None
+    for line in reversed([ln for ln in text.splitlines() if ln.strip()]):
+        try:
+            doc = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if doc is None:
+        print("no JSON object found in input", file=sys.stderr)
+        return 2
+    errors = validate(doc)
+    if errors:
+        for e in errors:
+            print(f"SCHEMA: {e}", file=sys.stderr)
+        return 1
+    print("bench schema OK")
+    return 0
+
+
+if __name__ == "__main__":
+    import os
+
+    # Standalone runs must not touch the axon/TPU backend: the obs import
+    # pulls the package root, which imports jax-heavy modules.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    sys.exit(main(sys.argv))

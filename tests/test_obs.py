@@ -1,0 +1,489 @@
+"""Unified observability layer (obs/): registry, exposition, instrumentation.
+
+Pins the ISSUE 5 contracts:
+- registry semantics: label cardinality bound, get-or-create registration,
+  histogram percentiles, prom-text golden output, snapshot <-> prom-text
+  round-trip;
+- BatchTimings as a registry consumer with complete components() under
+  every edge case (no drain yet, zero-match drains, no bytes pulled);
+- the batched engine's metrics ride existing pulls only: an
+  `advance(decode=False)` stream with metrics enabled performs ZERO device
+  syncs (the profile_sync block is the positive control proving the
+  detector catches real syncs);
+- streams-layer counters (host processor per-query match counts, LogDriver
+  poll/commit cadence + periodic reporter);
+- scripts/check_bench_schema.py accepts the documented artifact shape and
+  rejects undocumented/missing keys and corrupted metrics sections.
+"""
+from __future__ import annotations
+
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu import QueryBuilder, compile_pattern
+from kafkastreams_cep_tpu.core.event import Event
+from kafkastreams_cep_tpu.obs import (
+    MetricsRegistry,
+    SpanTracer,
+    parse_prom_text,
+    registry_from_snapshot,
+)
+from kafkastreams_cep_tpu.ops.engine import EngineConfig
+from kafkastreams_cep_tpu.ops.profiling import BatchTimings
+from kafkastreams_cep_tpu.ops.tables import compile_query
+from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA
+from kafkastreams_cep_tpu.pattern.expressions import value
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+)
+from check_bench_schema import validate as validate_bench_schema  # noqa: E402
+
+
+def letters_pattern():
+    return (
+        QueryBuilder()
+        .select("a").where(value() == "A")
+        .then().select("b").where(value() == "B")
+        .then().select("c").where(value() == "C")
+        .build()
+    )
+
+
+def tiny_engine(**kwargs) -> BatchedDeviceNFA:
+    query = compile_query(compile_pattern(letters_pattern()), None)
+    return BatchedDeviceNFA(
+        query, keys=["x"],
+        config=EngineConfig(lanes=8, nodes=128, matches=16),
+        **kwargs,
+    )
+
+
+# ------------------------------------------------------------- registry core
+def test_counter_gauge_labels_and_get_or_create():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "requests", labels=("route",))
+    c.labels(route="/a").inc()
+    c.labels(route="/a").inc(2)
+    c.labels(route="/b").inc()
+    assert c.labels(route="/a").value == 3
+    assert c.labels(route="/b").value == 1
+    g = reg.gauge("depth", "queue depth")
+    g.set(5)
+    g.inc()
+    g.dec(3)
+    assert g.value == 3
+    # Get-or-create: same name+type+labels returns the same family.
+    assert reg.counter("req_total", labels=("route",)) is c
+    # Type or label mismatch is a bug, not a new metric.
+    with pytest.raises(ValueError):
+        reg.gauge("req_total")
+    with pytest.raises(ValueError):
+        reg.counter("req_total", labels=("verb",))
+    with pytest.raises(ValueError):
+        c.labels(verb="GET")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_label_cardinality_bound():
+    reg = MetricsRegistry(max_label_sets=4)
+    c = reg.counter("c", labels=("k",))
+    for i in range(4):
+        c.labels(k=str(i)).inc()
+    with pytest.raises(ValueError, match="cardinality"):
+        c.labels(k="overflow")
+    # Existing label sets stay usable past the bound.
+    c.labels(k="0").inc()
+    assert c.labels(k="0").value == 2
+
+
+def test_histogram_buckets_and_percentiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v)
+    child = h.labels()
+    assert child.count == 5
+    assert math.isclose(child.sum, 5.605)
+    assert child.cumulative_buckets() == [
+        (0.01, 1), (0.1, 3), (1.0, 4), (math.inf, 5),
+    ]
+    assert h.percentile(50) == 0.05
+    assert h.percentile(100) == 5.0
+    assert reg.histogram("lat").percentile(0) == 0.005
+    assert MetricsRegistry().histogram("empty").percentile(50) is None
+
+
+def test_prom_text_golden():
+    reg = MetricsRegistry()
+    reg.counter("cep_events_total", "Events processed").inc(3)
+    g = reg.gauge("cep_fill", "Region fill", labels=("shard",))
+    g.labels(shard="0").set(7.5)
+    h = reg.histogram("cep_wall_seconds", "Wall", buckets=(0.5, 1.0))
+    h.observe(0.25)
+    h.observe(2.0)
+    assert reg.to_prom_text() == (
+        "# HELP cep_events_total Events processed\n"
+        "# TYPE cep_events_total counter\n"
+        "cep_events_total 3\n"
+        "# HELP cep_fill Region fill\n"
+        "# TYPE cep_fill gauge\n"
+        'cep_fill{shard="0"} 7.5\n'
+        "# HELP cep_wall_seconds Wall\n"
+        "# TYPE cep_wall_seconds histogram\n"
+        'cep_wall_seconds_bucket{le="0.5"} 1\n'
+        'cep_wall_seconds_bucket{le="1"} 1\n'
+        'cep_wall_seconds_bucket{le="+Inf"} 2\n'
+        "cep_wall_seconds_sum 2.25\n"
+        "cep_wall_seconds_count 2\n"
+    )
+
+
+def test_snapshot_prom_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("c_total", "c", labels=("q",)).labels(q="x").inc(41)
+    reg.gauge("g", "g").set(-2.5)
+    h = reg.histogram("h_seconds", "h", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 3.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    rebuilt = registry_from_snapshot(snap)
+    # The rebuilt registry renders the identical exposition text...
+    assert rebuilt.to_prom_text() == reg.to_prom_text()
+    # ...and the parsed wire view carries the same values.
+    parsed = parse_prom_text(reg.to_prom_text())
+    assert parsed["c_total"][(("q", "x"),)] == 41
+    assert parsed["g"][()] == -2.5
+    assert parsed["h_seconds_count"][()] == 3
+    assert parsed["h_seconds_bucket"][(("le", "+Inf"),)] == 3
+
+
+def test_prom_label_escaping_roundtrip_with_backslashes():
+    # Literal backslashes (e.g. a fallback reason carrying a path or
+    # regex) must survive escape -> parse exactly; chained str.replace
+    # unescaping corrupts '\\' + 'n' sequences.
+    tricky = 'err in C:\\new\\file "x"\nline2'
+    reg = MetricsRegistry()
+    reg.gauge("g", labels=("reason",)).labels(reason=tricky).set(1)
+    parsed = parse_prom_text(reg.to_prom_text())
+    assert parsed["g"][(("reason", tricky),)] == 1
+
+
+def test_histogram_bucket_mismatch_raises():
+    reg = MetricsRegistry()
+    h = reg.histogram("h", buckets=(0.1, 1.0))
+    # Re-registering without explicit buckets accepts the existing layout.
+    assert reg.histogram("h") is h
+    assert reg.histogram("h", buckets=(1.0, 0.1)) is h  # order-insensitive
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("h", buckets=(0.5, 5.0))
+
+
+def test_span_tracer_records():
+    reg = MetricsRegistry()
+    tracer = SpanTracer(reg)
+    with tracer.span("restore"):
+        pass
+    with tracer.span("restore"):
+        pass
+    snap = reg.snapshot()
+    counts = {
+        tuple(v["labels"].items()): v["value"]
+        for v in snap["cep_span_total"]["values"]
+    }
+    assert counts[(("span", "restore"),)] == 2
+    hist = snap["cep_span_seconds"]["values"][0]
+    assert hist["count"] == 2 and hist["sum"] >= 0
+
+
+# ------------------------------------------------------ BatchTimings consumer
+def test_components_complete_before_any_drain():
+    t = BatchTimings()
+    c = t.components()
+    assert set(c) == set(BatchTimings.COMPONENT_KEYS)
+    assert c["tunnel_mbps"] is None
+    t.record_advance(0.010, 64, post_s=0.004)
+    c = t.components()
+    assert set(c) == set(BatchTimings.COMPONENT_KEYS)
+    assert c["advance_ms"] == 10.0 and c["post_ms"] == 4.0
+    assert c["drain_pull_ms"] == 0.0 and c["decode_ms"] == 0.0
+    assert c["drain_bytes"] == 0.0 and c["tunnel_mbps"] is None
+
+
+def test_components_zero_match_and_byteless_drains():
+    t = BatchTimings()
+    t.record_advance(0.010, 64)
+    # A zero-match, zero-byte drain (empty-ring early return) must not
+    # fabricate a tunnel rate or drop keys.
+    t.record_drain(0.001, 0)
+    c = t.components()
+    assert set(c) == set(BatchTimings.COMPONENT_KEYS)
+    assert c["tunnel_mbps"] is None and c["drain_bytes"] == 0.0
+    # pull_s > 0 with zero bytes (probe-only drain) still claims no rate.
+    t.record_drain(0.002, 0, pull_s=0.001)
+    assert t.components()["tunnel_mbps"] is None
+    # Bytes + wall produce the rate.
+    t.record_drain(0.02, 5, pull_s=0.010, decode_s=0.001, bytes_pulled=10**6)
+    assert abs(t.components()["tunnel_mbps"] - 100.0) < 1e-6
+
+
+def test_batch_timings_writes_through_registry():
+    reg = MetricsRegistry()
+    t = BatchTimings(registry=reg)
+    t.record_advance(0.010, 64, post_s=0.002)
+    t.record_drain(0.004, 3, pull_s=0.001, decode_s=0.001, bytes_pulled=2048)
+    snap = reg.snapshot()
+    assert snap["cep_batches_total"]["values"][0]["value"] == 1
+    assert snap["cep_slots_total"]["values"][0]["value"] == 64
+    assert snap["cep_matches_total"]["values"][0]["value"] == 3
+    assert snap["cep_drain_bytes_total"]["values"][0]["value"] == 2048
+    assert snap["cep_advance_dispatch_seconds"]["values"][0]["count"] == 1
+    assert snap["cep_emit_latency_seconds"]["values"][0]["count"] == 1
+    assert snap["cep_tunnel_mbps"]["values"][0]["value"] > 0
+    # A fresh window over the same registry keeps the spine monotonic.
+    t2 = BatchTimings(registry=reg)
+    t2.record_advance(0.001, 8)
+    assert t2.summary()["batches"] == 1  # window reset
+    assert reg.snapshot()["cep_batches_total"]["values"][0]["value"] == 2
+
+
+# ------------------------------------------------------- engine integration
+def test_advance_zero_device_syncs_with_metrics_enabled(monkeypatch):
+    """decode=False advances with metrics enabled stay fully async: no
+    drain pull, no block_until_ready, no stats pull -- while the registry
+    still receives the host-side advance-path telemetry."""
+    # matches >> T * matches_per_step: the capacity guard stays armed
+    # (probes dispatch -- asynchronously) but can never force a pull in
+    # this window, whatever the probe landing order.
+    query = compile_query(compile_pattern(letters_pattern()), None)
+    bat = BatchedDeviceNFA(
+        query, keys=["x"],
+        config=EngineConfig(lanes=8, nodes=128, matches=1024),
+    )
+    # Warm every jitted program incl. a match-bearing drain OUTSIDE the
+    # counted window.
+    bat.advance({"x": [Event("x", v, 1000 + i, "t", 0, i)
+                       for i, v in enumerate("ABC")]})
+
+    calls = {"block": 0, "pull": 0, "device_get": 0}
+    import jax as jax_mod
+
+    real_block = jax_mod.block_until_ready
+    monkeypatch.setattr(
+        jax_mod, "block_until_ready",
+        lambda *a, **k: calls.__setitem__("block", calls["block"] + 1)
+        or real_block(*a, **k),
+    )
+    real_get = jax_mod.device_get
+    monkeypatch.setattr(
+        jax_mod, "device_get",
+        lambda *a, **k: calls.__setitem__("device_get", calls["device_get"] + 1)
+        or real_get(*a, **k),
+    )
+    real_pull = bat._pull_raw
+    monkeypatch.setattr(
+        bat, "_pull_raw",
+        lambda: calls.__setitem__("pull", calls["pull"] + 1) or real_pull(),
+    )
+
+    # Match-free stream: noise letters only.
+    for b in range(6):
+        xs = bat.pack({"x": [
+            Event("x", "Z", 2000 + 10 * b + i, "t", 0, 100 + 10 * b + i)
+            for i in range(4)
+        ]})
+        bat.advance_packed(xs, decode=False)
+    assert calls == {"block": 0, "pull": 0, "device_get": 0}
+    # The host-side telemetry still landed.
+    snap = bat.metrics.snapshot()
+    assert snap["cep_batches_total"]["values"][0]["value"] >= 6
+    assert "cep_gc_phase" in snap
+    # Positive control -- the same detector catches profile_sync's
+    # deliberate compute-wall blocks, so a regression cannot hide.
+    bat2 = tiny_engine(profile_sync=True)
+    calls2 = {"n": 0}
+    monkeypatch.setattr(
+        jax_mod, "block_until_ready",
+        lambda *a, **k: calls2.__setitem__("n", calls2["n"] + 1)
+        or real_block(*a, **k),
+    )
+    xs = bat2.pack({"x": [Event("x", "Z", 1000 + i, "t", 0, i)
+                          for i in range(4)]})
+    bat2.advance_packed(xs, decode=False)
+    assert calls2["n"] > 0
+
+
+def test_engine_drain_and_stats_telemetry():
+    bat = tiny_engine()
+    out = bat.advance({"x": [Event("x", v, 1000 + i, "t", 0, i)
+                             for i, v in enumerate("XABC")]})
+    assert sum(len(v) for v in out.values()) == 1
+    _ = bat.stats  # explicit sync refreshes the state-counter gauges
+    snap = bat.metrics.snapshot()
+    info = snap["cep_engine_info"]["values"][0]["labels"]
+    assert info["engine"] == "xla" and info["drain_mode"] == "flat"
+    state = {
+        v["labels"]["counter"]: v["value"]
+        for v in snap["cep_engine_state_counter"]["values"]
+    }
+    assert state["n_events"] == 4 and state["match_drops"] == 0
+    assert snap["cep_pending_matches"]["values"][0]["value"] == 1
+    assert snap["cep_matches_total"]["values"][0]["value"] == 1
+    assert snap["cep_gc_flushes_total"]["values"][0]["value"] >= 1
+    assert snap["cep_gc_phase"]["values"][0]["value"] == 0
+    # Per-shard aggregation (one shard on the unsharded key axis).
+    shard = bat.shard_stats()
+    assert shard["n_events"].tolist() == [4]
+    snap = bat.metrics.snapshot()
+    per_shard = {
+        (v["labels"]["counter"], v["labels"]["shard"]): v["value"]
+        for v in snap["cep_shard_state_counter"]["values"]
+    }
+    assert per_shard[("n_events", "0")] == 4
+
+
+def test_two_engines_share_registry_distinct_instances():
+    """Engines deliberately sharing one registry keep per-instance gauge
+    series apart via the bound `instance` label."""
+    reg = MetricsRegistry()
+    a = tiny_engine(registry=reg)
+    b = tiny_engine(registry=reg)
+    assert a.instance_id != b.instance_id
+    a.advance({"x": [Event("x", v, 1000 + i, "t", 0, i)
+                     for i, v in enumerate("ABC")]})
+    snap = reg.snapshot()
+    pend = {
+        v["labels"]["instance"]: v["value"]
+        for v in snap["cep_pending_matches"]["values"]
+    }
+    assert pend[a.instance_id] == 1
+    assert pend[b.instance_id] == 0
+
+
+# ---------------------------------------------------------- streams metrics
+def test_host_processor_per_query_counters():
+    from kafkastreams_cep_tpu import CEPProcessor
+
+    reg = MetricsRegistry()
+    proc = CEPProcessor("Q1", letters_pattern(), registry=reg)
+    n_matches = 0
+    for i, ch in enumerate("XABC"):
+        n_matches += len(proc.process("k", ch, timestamp=i, topic="t", offset=i))
+    assert n_matches == 1
+    # Replayed record below the HWM is skipped and counted as such.
+    assert proc.process("k", "A", timestamp=0, topic="t", offset=0) == []
+    snap = reg.snapshot()
+
+    def val(name):
+        return {
+            v["labels"]["query"]: v["value"] for v in snap[name]["values"]
+        }["q1"]
+
+    assert val("cep_processor_records_total") == 4
+    assert val("cep_processor_matches_total") == 1
+    assert val("cep_processor_skipped_total") == 1
+
+
+def test_log_driver_metrics_and_reporter():
+    from kafkastreams_cep_tpu import ComplexStreamsBuilder, LogDriver, RecordLog, produce
+
+    log = RecordLog()
+    for i, ch in enumerate("XABC"):
+        produce(log, "letters", "K", ch, timestamp=i)
+    builder = ComplexStreamsBuilder(log=log, app_id="obs-demo")
+    reg = MetricsRegistry()
+    # registry= flows through the builder into the query's processor, so
+    # driver cadence AND per-query counters share one spine.
+    builder.stream("letters").query(
+        "q", letters_pattern(), registry=reg
+    ).to("matches")
+    topo = builder.build()
+    reports = []
+    driver = LogDriver(
+        topo, group="g-obs", registry=reg,
+        report_every_s=0.0, reporter=reports.append,
+    )
+    assert driver.poll() == 4
+    snap = reg.snapshot()
+
+    def val(name):
+        return {
+            v["labels"]["group"]: v["value"] for v in snap[name]["values"]
+        }["g-obs"]
+
+    assert val("cep_driver_polls_total") == 1
+    assert val("cep_driver_records_total") == 4
+    assert val("cep_driver_commits_total") == 1
+    assert val("cep_driver_restore_seconds") >= 0
+    # The query's per-record counters landed in the SAME registry.
+    per_q = {
+        v["labels"]["query"]: v["value"]
+        for v in snap["cep_processor_records_total"]["values"]
+    }
+    assert per_q["q"] == 4
+    # report_every_s=0 fires the reporter on every poll with prom text.
+    assert len(reports) == 1
+    assert "cep_driver_records_total" in reports[0]
+    assert val("cep_driver_reports_total") == 1
+
+
+# ------------------------------------------------------------- bench schema
+def _valid_artifact():
+    reg = MetricsRegistry()
+    reg.counter("cep_batches_total", "b").inc(2)
+    reg.histogram("cep_drain_seconds", "d", buckets=(0.1, 1.0)).observe(0.05)
+    components = dict(
+        advance_ms=1.0, post_ms=0.5, drain_pull_ms=0.2, decode_ms=0.1,
+        drain_bytes=1024.0, tunnel_mbps=None,
+    )
+    return {
+        "metric": "events_per_sec_skip_any8_batched",
+        "value": 123.0,
+        "unit": "events/s",
+        "vs_baseline": 2.0,
+        "p99_match_emit_ms": 5.0,
+        "components": components,
+        "tunnel_mbps": None,
+        "tunnel_degraded": False,
+        "latency_p99_match_emit_ms": 4.0,
+        "platform": "cpu",
+        "quick": True,
+        "denominator": "python_host_port_no_jvm_available",
+        "configs": {"skip_any8_batched": {"components": dict(components)}},
+        "metrics": reg.snapshot(),
+    }
+
+
+def test_bench_schema_accepts_documented_shape():
+    assert validate_bench_schema(_valid_artifact()) == []
+
+
+def test_bench_schema_rejects_missing_and_undocumented_keys():
+    art = _valid_artifact()
+    del art["tunnel_degraded"]
+    art["surprise"] = 1
+    errors = validate_bench_schema(art)
+    assert any("tunnel_degraded" in e for e in errors)
+    assert any("surprise" in e for e in errors)
+    # Component breakdown is part of the contract too.
+    art2 = _valid_artifact()
+    del art2["components"]["post_ms"]
+    art2["components"]["extra_ms"] = 1.0
+    errors = validate_bench_schema(art2)
+    assert any("post_ms" in e for e in errors)
+    assert any("extra_ms" in e for e in errors)
+
+
+def test_bench_schema_catches_metrics_roundtrip_corruption():
+    art = _valid_artifact()
+    # Corrupt the snapshot: a bucket count that disagrees with `count`
+    # cannot survive the prom-text round-trip comparison.
+    fam = art["metrics"]["cep_drain_seconds"]["values"][0]
+    fam["count"] = fam["count"] + 5
+    errors = validate_bench_schema(art)
+    assert any("round-trip" in e for e in errors)
