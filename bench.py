@@ -611,6 +611,21 @@ def bench_multi_query(
     )
 
 
+def _fault_block(flagship_metrics: Dict[str, Any]) -> Dict[str, float]:
+    """The artifact's `faults` block: FAULT_SERIES totals summed over the
+    flagship engine's registry snapshot and the process-default registry
+    (driver/store-layer counters land there). All-zero in a healthy run."""
+    from kafkastreams_cep_tpu.obs.registry import (
+        default_registry,
+        fault_series_totals,
+        registry_from_snapshot,
+    )
+
+    return fault_series_totals(
+        registry_from_snapshot(flagship_metrics), default_registry()
+    )
+
+
 def main() -> None:
     quick = ARGS.quick
     which = [c.strip() for c in ARGS.configs.split(",") if c.strip()]
@@ -842,6 +857,12 @@ def main() -> None:
         # metric). scripts/check_bench_schema.py proves this section and
         # its prom-text rendering carry the same values.
         "metrics": flagship_metrics,
+        # Fault/robustness counter totals (ISSUE 6): flagship-registry +
+        # process-default sums of every FAULT_SERIES family. All-zero in a
+        # healthy run -- a nonzero value here means the bench itself hit
+        # retries/backpressure/drops and the artifact must be read with
+        # that in mind. scripts/check_bench_schema.py pins the key set.
+        "faults": _fault_block(flagship_metrics),
     }
     if ARGS.smoke:
         # Smoke artifacts must stay self-describing: validate the JSON

@@ -1,0 +1,89 @@
+"""Chaos soak CLI: sweep seeded fault schedules against a live pipeline.
+
+    python -m kafkastreams_cep_tpu.faults --seeds 32 [--runtime tpu]
+
+For each seed it builds a fresh durable pipeline (letters query over a
+file-backed RecordLog in a temp dir), computes the fault-free golden sink
+stream, then replays the same stream under a seeded `FaultSchedule`,
+rebuilding from disk after every simulated crash -- the same harness as
+tests/test_faults.py, sized for soaking rather than CI. Any divergence
+(lost or duplicated match) prints the seed and exits nonzero, so a failing
+seed reproduces with `--seeds-from N --seeds 1`.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+# Keep the soak local: same backend pinning as tests/conftest.py (the axon
+# PJRT plugin otherwise hangs the process when the TPU tunnel is down).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=16, help="how many seeds")
+    ap.add_argument("--seeds-from", type=int, default=0, help="first seed")
+    ap.add_argument("--runtime", default="host", choices=["host", "tpu"])
+    ap.add_argument("--events", type=int, default=48, help="stream length")
+    ap.add_argument("--points", type=int, default=3, help="faults per seed")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "tests",
+        ),
+    )
+    from test_faults import (  # the CI harness, reused verbatim
+        DRIVER_SITES,
+        DEVICE_OPTS,
+        _chaos,
+        _golden,
+        _stream,
+    )
+
+    from . import FaultSchedule
+
+    sites = DRIVER_SITES + (
+        ("engine.mid_drain",) if args.runtime == "tpu" else ()
+    )
+    opts = dict(DEVICE_OPTS) if args.runtime == "tpu" else {}
+    keys = ("k0", "k1") if args.runtime == "tpu" else ("K",)
+    failures = 0
+    for seed in range(args.seeds_from, args.seeds_from + args.seeds):
+        stream = _stream(seed, n=args.events)
+        golden = _golden(stream, keys=keys, runtime=args.runtime, **opts)
+        schedule = FaultSchedule.seeded(seed, sites=sites,
+                                        n_points=args.points)
+
+        class _Tmp:
+            def __truediv__(self, name):
+                import pathlib
+
+                return pathlib.Path(tempfile.mkdtemp()) / name
+
+        chaos, crashes = _chaos(
+            _Tmp(), schedule, stream, keys=keys, runtime=args.runtime, **opts
+        )
+        ok = sorted(chaos) == sorted(golden)
+        print(
+            f"seed {seed}: {len(golden)} matches, {crashes} crashes, "
+            f"{'OK' if ok else 'DIVERGED'}"
+        )
+        if not ok:
+            failures += 1
+            print(f"  schedule: {schedule}")
+    print(f"{args.seeds} seeds, {failures} divergent")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

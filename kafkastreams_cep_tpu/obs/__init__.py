@@ -8,11 +8,13 @@ scripts/check_bench_schema.py validates. See PERF.md "v10" for the full
 metrics dictionary.
 """
 from .registry import (
+    FAULT_SERIES,
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
     default_registry,
+    fault_series_totals,
     parse_prom_text,
     registry_from_snapshot,
 )
@@ -20,11 +22,13 @@ from .trace import SpanTracer
 
 __all__ = [
     "Counter",
+    "FAULT_SERIES",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "SpanTracer",
     "default_registry",
+    "fault_series_totals",
     "parse_prom_text",
     "registry_from_snapshot",
 ]

@@ -32,14 +32,47 @@ from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 
 __all__ = [
     "Counter",
+    "FAULT_SERIES",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "default_registry",
+    "fault_series_totals",
     "next_instance_id",
     "parse_prom_text",
     "registry_from_snapshot",
 ]
+
+#: The fault/robustness counter families (ISSUE 6) every health surface
+#: reports together: bench.py --smoke emits them as the `faults` block
+#: (all-zero in a healthy run) and scripts/check_bench_schema.py validates
+#: the block against this exact key set.
+FAULT_SERIES: Tuple[str, ...] = (
+    "cep_faults_injected_total",
+    "cep_retries_total",
+    "cep_overflow_backpressure_total",
+    "cep_overflow_dropped_total",
+    "cep_driver_dead_letters_total",
+    "cep_driver_restore_failures_total",
+    "cep_checkpoint_corrupt_total",
+    "cep_emit_deduped_total",
+)
+
+
+def fault_series_totals(*registries: "MetricsRegistry") -> Dict[str, float]:
+    """Label-summed totals of every FAULT_SERIES counter across the given
+    registries (0.0 for families never registered) -- one flat dict a
+    health check can assert all-zero on."""
+    out: Dict[str, float] = {name: 0.0 for name in FAULT_SERIES}
+    for reg in registries:
+        for name in FAULT_SERIES:
+            metric = reg.get(name)
+            if metric is None:
+                continue
+            out[name] += sum(
+                child.value for _lv, child in metric._sorted_children()
+            )
+    return out
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")

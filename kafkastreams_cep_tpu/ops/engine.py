@@ -87,6 +87,14 @@ STATE_COUNTER_KEYS = (
     "lane_drops", "node_drops", "match_drops", "seq_collisions",
 )
 
+#: The silent-loss counters the overflow policy (EngineConfig.on_overflow)
+#: watches at drain boundaries.
+DROP_COUNTER_KEYS = ("lane_drops", "node_drops", "match_drops")
+
+# Typed escalation for on_overflow="raise"/"block"; defined in the
+# host-only faults package so streams-layer callers need not import jax.
+from ..faults.injection import CEPOverflowError  # noqa: E402,F401
+
 
 @dataclass(frozen=True)
 class EngineConfig:
@@ -137,6 +145,32 @@ class EngineConfig:
     #: flushes, so size `nodes` for the group's retention (PERF.md v9
     #: "GC groups"). G=1 is the classic every-advance GC.
     gc_group: int = 1
+    #: Capacity-overflow policy (ISSUE 6). The reference never drops a
+    #: match (SharedVersionedBufferStoreImpl.java:101-126); the device
+    #: engine's fixed pools can, and this knob decides how loudly:
+    #:   "drop"  -- today's semantics, but every drop delta observed at a
+    #:              drain boundary lands in the per-instance
+    #:              `cep_overflow_dropped_total{counter}` counters;
+    #:   "raise" -- a drop delta (or a replay-ledger overflow /
+    #:              fold-divergence degradation) raises CEPOverflowError;
+    #:   "block" -- backpressure: before an advance whose worst case could
+    #:              overflow the pend ring (or while region pressure
+    #:              persists), force a synchronous early drain + group
+    #:              flush and retry admission (bounded by `block_retries`,
+    #:              linear backoff), surfaced via
+    #:              `cep_overflow_backpressure_total`; residual drops
+    #:              escalate like "raise".
+    on_overflow: str = "drop"
+    #: Bounded admission retries for on_overflow="block".
+    block_retries: int = 4
+    #: Linear backoff step between blocked-admission retries (seconds).
+    block_backoff_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.on_overflow not in ("drop", "raise", "block"):
+            raise ValueError(
+                f"on_overflow must be drop|raise|block, got {self.on_overflow!r}"
+            )
 
     def dewey_width(self, query: CompiledQuery) -> int:
         return self.digits if self.digits > 0 else query.n_stages + 2

@@ -26,10 +26,13 @@ import jax.numpy as jnp
 
 from ..core.event import Event
 from ..core.sequence import Sequence, SequenceBuilder, Staged
+from ..faults import injection as _flt
+from ..faults.injection import CEPOverflowError, TransientFault, with_retry
 from ..pattern.stages import Stages
 import jax
 
 from .engine import (
+    DROP_COUNTER_KEYS,
     STATE_COUNTER_KEYS,
     WINDOW_PLANES,
     EngineConfig,
@@ -83,6 +86,15 @@ class DeviceNFA:
             "(updated on the explicit stats sync, never on the advance path)",
             labels=("instance", "counter"),
         )
+        self._m_dropped = self.metrics.counter(
+            "cep_overflow_dropped_total",
+            "Engine drop-counter deltas observed at drain boundaries "
+            "(silent capacity loss made loud; see EngineConfig.on_overflow)",
+            labels=("counter",),
+        )
+        #: Overflow-policy baselines (deltas, not totals -- restores carry
+        #: historic totals that must not re-escalate).
+        self._drop_base: Dict[str, int] = {}
         self.config = config if config is not None else EngineConfig()
         self._advance = build_batch_fn(self.query, self.config)
         self._append_post = jax.jit(build_append_post(self.config))
@@ -184,7 +196,19 @@ class DeviceNFA:
         if not events:
             return []
         xs = self._pack(events)
-        self.state, ys = self._advance(self.state, xs)
+        if _flt.ACTIVE is None:
+            self.state, ys = self._advance(self.state, xs)
+        else:
+            # `engine.device_step` transient site (see parallel/batched.py:
+            # the dispatch is functional, so a bounded retry is exact).
+            def _step():
+                _flt.ACTIVE.fire("engine.device_step")
+                return self._advance(self.state, xs)
+
+            self.state, ys = with_retry(
+                _step, site="engine.device_step",
+                retry_on=(TransientFault,), registry=self.metrics,
+            )
         self.state, self.pool, page_roots = self._append_post(
             self.state, self.pool, ys
         )
@@ -210,6 +234,12 @@ class DeviceNFA:
                     )
                 self._interval_overflow = True
                 self._interval_events = []
+                if self.config.on_overflow == "raise":
+                    raise CEPOverflowError(
+                        "exact-replay event ledger overflowed "
+                        f"({self.REPLAY_LEDGER_MAX_EVENTS} events without a "
+                        "drain); drain() more often or raise the bound"
+                    )
             else:
                 self._interval_events.extend(events)
         if not decode:
@@ -242,7 +272,32 @@ class DeviceNFA:
         if self.exact_replay:
             matches = self._replay_boundary(matches)
         self._prune_events()
+        self._check_drop_counters(drained=matches)
         return matches
+
+    def _check_drop_counters(self, drained: Optional[List] = None) -> None:
+        """Drain-boundary overflow-policy check (EngineConfig.on_overflow):
+        single-key state counters are scalars, so the pull is free at this
+        sync point. Deltas land in `cep_overflow_dropped_total{counter}`;
+        "raise"/"block" escalate (see parallel/batched.py for the batched
+        rationale)."""
+        overflow = {}
+        for name in DROP_COUNTER_KEYS:
+            v = int(self.state[name])
+            delta = v - self._drop_base.get(name, 0)
+            if delta > 0:
+                overflow[name] = delta
+                self._drop_base[name] = v
+                self._m_dropped.labels(counter=name).inc(delta)
+        if overflow and self.config.on_overflow in ("raise", "block"):
+            # Drained matches ride the exception -- see parallel/batched.py.
+            exc = CEPOverflowError(
+                f"engine capacity overflow since the last drain: {overflow} "
+                f"(policy {self.config.on_overflow!r}; size EngineConfig "
+                "lanes/nodes/matches)"
+            )
+            exc.matches = drained if drained is not None else []
+            raise exc
 
     def _replay_boundary(self, matches: List[Sequence]) -> List[Sequence]:
         """Drain-boundary replay hook: if any fold-divergence event fired
@@ -410,6 +465,7 @@ class DeviceNFA:
             MAGIC,
             encode_array_tree,
             encode_event_registry,
+            seal_frame,
         )
 
         w = _Writer()
@@ -420,7 +476,7 @@ class DeviceNFA:
         w.i64(self._next_gidx)
         w.i64(self._ts_base if self._ts_base is not None else -1)
         w.i64(self._batches)
-        return w.getvalue()
+        return seal_frame(w.getvalue())
 
     @classmethod
     def restore(
@@ -437,12 +493,13 @@ class DeviceNFA:
             _Reader,
             decode_array_tree,
             decode_event_registry,
+            open_frame,
             read_magic,
             upgrade_checkpoint_trees,
         )
 
         dev = cls(stages_or_query, schema=schema, config=config)
-        r = _Reader(data)
+        r = _Reader(open_frame(data))
         read_magic(r)
         tree = decode_array_tree(r.blob())
         pool_tree = decode_array_tree(r.blob())
@@ -458,6 +515,7 @@ class DeviceNFA:
             dev._snap = (dev.state, dev.pool)
             dev._interval_start_gidx = dev._next_gidx
             dev._collision_base = int(dev.state["seq_collisions"])
+        dev._drop_base = {k: int(dev.state[k]) for k in DROP_COUNTER_KEYS}
         return dev
 
     def _prune_events(self) -> None:

@@ -26,7 +26,10 @@ import jax.numpy as jnp
 
 from ..core.event import Event
 from ..core.sequence import Sequence
+from ..faults import injection as _flt
+from ..faults.injection import CEPOverflowError, TransientFault, with_retry
 from ..ops.engine import (
+    DROP_COUNTER_KEYS,
     STATE_COUNTER_KEYS,
     WINDOW_PLANES,
     EngineConfig,
@@ -238,6 +241,12 @@ class BatchedDeviceNFA:
         self._ts_base: Optional[int] = None
         self._batches = 0
         self._stats_fn = None
+        #: Overflow-policy bookkeeping (EngineConfig.on_overflow): drop
+        #: counter baselines advanced at each drain-boundary check, so
+        #: deltas -- not totals -- feed the policy (restored checkpoints
+        #: carry historic totals that must not re-escalate).
+        self._drop_base: Dict[str, int] = {k: 0 for k in DROP_COUNTER_KEYS}
+        self._drop_check_fn = None
         #: Exact-replay (ops/replay.py): per-key fold-divergence recovery.
         #: At each drain, keys whose seq_collisions counter moved replay
         #: their interval through the host oracle (reference-exact per-run
@@ -360,6 +369,17 @@ class BatchedDeviceNFA:
             "Engine state counter totals from the last stats pull "
             "(updated on the explicit stats sync, never on the advance path)",
             labels=("instance", "counter"),
+        )
+        self._m_backpressure = r.counter(
+            "cep_overflow_backpressure_total",
+            "Blocked admissions under on_overflow='block' (forced early "
+            "drain + group flush before the advance)",
+        )
+        self._m_dropped = r.counter(
+            "cep_overflow_dropped_total",
+            "Engine drop-counter deltas observed at drain boundaries "
+            "(silent capacity loss made loud; see EngineConfig.on_overflow)",
+            labels=("counter",),
         )
 
     def _pick_engine(self, engine: str) -> Tuple[str, Optional[str]]:
@@ -650,6 +670,12 @@ class BatchedDeviceNFA:
         """
         T = int(xs["valid"].shape[0])
         step_cap = T * self.config.matches_per_step
+        if self.config.on_overflow == "block":
+            # Backpressure admission: never dispatch an advance whose worst
+            # case could overflow the pend ring (or while region pressure
+            # persists) -- force a synchronous early drain + group flush
+            # and retry, bounded (EngineConfig.block_retries).
+            self._block_admission(step_cap)
         # The capacity guard only applies when a whole per-advance page
         # fits the ring (step_cap <= matches): there the worst-case cursor
         # growth is bounded per matching advance and a pre-advance drain
@@ -731,18 +757,42 @@ class BatchedDeviceNFA:
                 # one-shot warning (cleared at the next replay boundary).
                 self._m_ledger_overflow.set(1)
                 self._interval_packs = []
+                if self.config.on_overflow == "raise":
+                    # Overflow-policy escalation (gauge + warning behavior
+                    # above stays pinned): a degraded replay interval is a
+                    # correctness hazard the "raise" policy must not let
+                    # pass silently.
+                    raise CEPOverflowError(
+                        "exact-replay event ledger overflowed "
+                        f"({self.REPLAY_LEDGER_MAX_BATCHES} batches without "
+                        "a drain); drain() more often or raise the bound"
+                    )
             else:
                 self._interval_packs.append(entry)
         import time as _time
 
         t0 = _time.perf_counter()
         try:
-            self.state, ys = self._advance(self.state, xs)
+            if _flt.ACTIVE is None:
+                self.state, ys = self._advance(self.state, xs)
+            else:
+                # `engine.device_step` transient site: the advance dispatch
+                # is functional (state reassigned only on success), so a
+                # bounded retry is exact. Disarmed, the production path
+                # pays the one module-attribute check above.
+                def _step():
+                    _flt.ACTIVE.fire("engine.device_step")
+                    return self._advance(self.state, xs)
+
+                self.state, ys = with_retry(
+                    _step, site="engine.device_step",
+                    retry_on=(TransientFault,), registry=self.metrics,
+                )
         except Exception as exc:
             if (
                 not (self.engine == "pallas" and self._engine_auto)
                 or self._batches > 0
-                or isinstance(exc, ValueError)
+                or isinstance(exc, (ValueError, TransientFault))
             ):
                 # Only first-use, non-input-validation failures qualify:
                 # ValueError is the advance's own argument checking (a
@@ -855,6 +905,12 @@ class BatchedDeviceNFA:
         raw = self._pull_raw()
         if raw is not None:
             self._submit_decode(raw)
+        if _flt.ACTIVE is not None:
+            # `engine.mid_drain` crash site: the ring was pulled + cleared
+            # on device but the decode worker has not handed matches back
+            # -- a crash here loses every in-flight match unless the
+            # pipeline above recovers from its last commit.
+            _flt.ACTIVE.fire("engine.mid_drain")
         # Join the decode worker: futures are FIFO (single worker thread),
         # so matches from earlier auto-drains land before this drain's in
         # every key's list -- drain boundaries never reorder.
@@ -908,6 +964,14 @@ class BatchedDeviceNFA:
                     "differ from the host oracle. " + remedy,
                     RuntimeWarning,
                 )
+                if self.config.on_overflow == "raise":
+                    # Overflow-policy escalation (satellite: gauge +
+                    # warning behavior above stays pinned).
+                    raise CEPOverflowError(
+                        "fold divergence detected with exact replay "
+                        "unavailable; matches may differ from the oracle. "
+                        + remedy
+                    )
         # Prune AFTER decoding: the raw snapshot's chains reference events
         # by gidx, and materialized Sequences hold the Event objects. The
         # decode worker is idle here (all futures joined above), so the
@@ -922,7 +986,76 @@ class BatchedDeviceNFA:
             _time.perf_counter() - t0, sum(len(v) for v in out.values()),
             pull_s=pull_s, decode_s=decode_s, bytes_pulled=bytes_pulled,
         )
+        self._check_drop_counters(drained=out)
         return out
+
+    def _check_drop_counters(self, drained: Optional[Dict] = None) -> None:
+        """Drain-boundary overflow-policy check: pull the three drop
+        counters (one tiny fused reduction -- the drain is already a sync
+        point), make any delta loud in
+        `cep_overflow_dropped_total{counter}`, and escalate per
+        `EngineConfig.on_overflow` ("raise" always; "block" because a drop
+        under backpressure means the admission guard's sizing contract was
+        violated and silence would forfeit the loss-free promise)."""
+        if self._drop_check_fn is None:
+            self._drop_check_fn = jax.jit(
+                lambda s: jnp.stack([s[k].sum() for k in DROP_COUNTER_KEYS])
+            )
+        vals = np.asarray(self._drop_check_fn(self.state))
+        overflow: Dict[str, int] = {}
+        for name, v in zip(DROP_COUNTER_KEYS, vals.tolist()):
+            delta = int(v) - self._drop_base[name]
+            if delta > 0:
+                overflow[name] = delta
+                self._drop_base[name] = int(v)
+                self._m_dropped.labels(counter=name).inc(delta)
+        if overflow and self.config.on_overflow in ("raise", "block"):
+            # The ring was already pulled and cleared: the successfully
+            # drained matches ride the exception (`.matches`) so the
+            # escalation is loud without compounding the loss.
+            exc = CEPOverflowError(
+                f"engine capacity overflow since the last drain: {overflow} "
+                f"(policy {self.config.on_overflow!r}; size EngineConfig "
+                "lanes/nodes/matches or use on_overflow='block')"
+            )
+            exc.matches = drained if drained is not None else {}
+            raise exc
+
+    def _block_admission(self, step_cap: int) -> None:
+        """on_overflow="block": hold the advance until its worst case fits.
+
+        Forces a synchronous early drain (+ group flush) and retries the
+        admission check, bounded by `block_retries` with linear backoff;
+        every forced round is one `cep_overflow_backpressure_total` tick.
+        In the compact-append regime (step_cap > matches) the ring can
+        never absorb the worst case, so admission degrades to "ring must
+        be empty before every advance" -- true per-advance match volume
+        then bounds what the ring must hold."""
+        import time as _time
+
+        cfg = self.config
+        for attempt in range(cfg.block_retries + 1):
+            occ, fill, _ = self._occupancy_bound()
+            if step_cap <= cfg.matches:
+                need = (
+                    occ + step_cap > cfg.matches
+                    or fill > (3 * cfg.nodes) // 4
+                )
+            else:
+                need = occ > 0
+            if not need:
+                return
+            if attempt == cfg.block_retries:
+                # Bounded: proceed; a residual drop escalates loudly at
+                # the next drain boundary (_check_drop_counters).
+                return
+            self._m_backpressure.inc()
+            raw = self._pull_raw()
+            if raw is not None:
+                self._submit_decode(raw)
+            self._flush_group()
+            if cfg.block_backoff_s > 0:
+                _time.sleep(cfg.block_backoff_s * (attempt + 1))
 
     def _replay_boundary(
         self, out: Dict[Any, List[Sequence]]
@@ -1057,6 +1190,7 @@ class BatchedDeviceNFA:
             MAGIC,
             encode_array_tree,
             encode_event_registry,
+            seal_frame,
         )
 
         w = _Writer()
@@ -1068,7 +1202,7 @@ class BatchedDeviceNFA:
         w.i64(self._next_gidx)
         w.i64(self._ts_base if self._ts_base is not None else -1)
         w.i64(self._batches)
-        return w.getvalue()
+        return seal_frame(w.getvalue())
 
     @classmethod
     def restore(
@@ -1079,6 +1213,7 @@ class BatchedDeviceNFA:
         config: Optional[EngineConfig] = None,
         mesh: Optional[Any] = None,
         engine: str = "auto",
+        **opts: Any,
     ) -> "BatchedDeviceNFA":
         import pickle
 
@@ -1086,16 +1221,17 @@ class BatchedDeviceNFA:
             _Reader,
             decode_array_tree,
             decode_event_registry,
+            open_frame,
             read_magic,
             upgrade_checkpoint_trees,
         )
 
-        r = _Reader(data)
+        r = _Reader(open_frame(data))
         read_magic(r)
         keys = pickle.loads(r.blob())
         bat = cls(
             stages_or_query, keys=keys, schema=schema, config=config,
-            mesh=mesh, engine=engine,
+            mesh=mesh, engine=engine, **opts,
         )
         tree = decode_array_tree(r.blob())
         pool_tree = decode_array_tree(r.blob())
@@ -1135,6 +1271,12 @@ class BatchedDeviceNFA:
         ts_base = r.i64()
         bat._ts_base = None if ts_base < 0 else ts_base
         bat._batches = r.i64()
+        # Historic drop totals ride the checkpoint; the overflow policy
+        # watches deltas, so re-baseline here or a restore would
+        # re-escalate losses a previous incarnation already reported.
+        bat._drop_base = {
+            k: int(np.asarray(bat.state[k]).sum()) for k in DROP_COUNTER_KEYS
+        }
         if bat.exact_replay:
             bat._snap = (bat.state, bat.pool)
             bat._collision_base = np.asarray(

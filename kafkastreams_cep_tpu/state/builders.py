@@ -172,7 +172,11 @@ def restore_store(typed_store: Any) -> int:
     """Replay a typed store's changelog (if its KV stack has one) into the
     bottom store; returns records applied. The restore bypasses the logging
     layer so replay does not re-append (the reference's restore path does
-    the same via the restore consumer)."""
+    the same via the restore consumer). Stores owning their own restore
+    protocol (the device-runtime checkpoint store) delegate to it."""
+    restore_cl = getattr(typed_store, "restore_from_changelog", None)
+    if restore_cl is not None:
+        return restore_cl()
     kv = getattr(typed_store, "_kv", None)
     n = 0
     while kv is not None:

@@ -11,6 +11,11 @@ from __future__ import annotations
 STATES_SUFFIX = "-streamscep-states"
 MATCHED_SUFFIX = "-streamscep-matched"
 AGGREGATES_SUFFIX = "-streamscep-aggregates"
+#: Emitted-match watermark store (exactly-once sink dedupe, ISSUE 6) and
+#: the device-runtime engine checkpoint store -- same naming scheme as the
+#: reference trio so operators find one layout.
+EMITTED_SUFFIX = "-streamscep-emitted"
+DEVICE_STATE_SUFFIX = "-streamscep-devicestate"
 
 
 def normalize_query_name(query_name: str) -> str:
@@ -29,3 +34,11 @@ def event_buffer_store(query_name: str) -> str:
 
 def aggregates_store(query_name: str) -> str:
     return normalize_query_name(query_name) + AGGREGATES_SUFFIX
+
+
+def emitted_store(query_name: str) -> str:
+    return normalize_query_name(query_name) + EMITTED_SUFFIX
+
+
+def device_state_store(query_name: str) -> str:
+    return normalize_query_name(query_name) + DEVICE_STATE_SUFFIX

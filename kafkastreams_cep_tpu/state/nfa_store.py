@@ -32,6 +32,42 @@ class NFAStates(Generic[K, V]):
         return self.latest_offsets.get(topic)
 
 
+@dataclass
+class EmitWatermark:
+    """Persisted emitted-match high-watermark for one query (ISSUE 6).
+
+    `sink_pos` records each sink topic's end offset at the last commit:
+    after a crash, the driver re-scans only the tail past these positions
+    to learn which matches the sink already saw (exactly-once recovery --
+    streams/emission.py). Externalized like every other piece of execution
+    state: through the changelogged store stack, at commit time."""
+
+    sink_pos: Dict[str, int] = field(default_factory=dict)
+
+
+class EmissionStore(Generic[K, V]):
+    """Single-value store holding a query's `EmitWatermark` (same KV-stack
+    durability toggles as the reference trio)."""
+
+    _KEY = "watermark"
+
+    def __init__(self, backing: Optional[Any] = None) -> None:
+        if backing is None:
+            from .store import InMemoryKeyValueStore
+
+            backing = InMemoryKeyValueStore("emitted")
+        self._kv = backing
+
+    def get(self) -> Optional[EmitWatermark]:
+        return self._kv.get(self._KEY)
+
+    def put(self, watermark: EmitWatermark) -> None:
+        self._kv.put(self._KEY, watermark)
+
+    def flush(self) -> None:
+        self._kv.flush()
+
+
 class NFAStore(Generic[K, V]):
     """Per-key snapshot store (NFAStoreImpl.java:60-84).
 

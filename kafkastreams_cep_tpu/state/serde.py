@@ -38,6 +38,96 @@ MAGIC = b"KCT5"  # format tag + version (5: interval pinning -- pool carries
 COMPAT_MAGIC = (b"KCT3", b"KCT4")
 
 
+class CheckpointError(ValueError):
+    """A checkpoint payload failed validation: truncated frame, trailing
+    garbage, bad magic, or CRC mismatch. Subclasses ValueError so callers
+    of the pre-typed decoders keep working; new code should catch this."""
+
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli) integrity frames
+# ---------------------------------------------------------------------------
+#: Seal marker for CRC-framed checkpoint payloads. Payloads themselves
+#: always begin with a KCT* magic, so the marker can never collide with a
+#: legacy (unsealed) checkpoint -- `open_frame` stays backward compatible.
+CRC_MARKER = b"KCRC"
+_CRC_HEADER = struct.Struct("<IQ")  # crc32c, payload length
+
+
+def _crc32c_tables() -> List[List[int]]:
+    """Slicing-by-8 tables for the Castagnoli polynomial (reflected
+    0x82F63B78) -- pure Python, ~8 bytes per loop iteration."""
+    t0 = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        t0.append(c)
+    tables = [t0]
+    for _ in range(7):
+        prev = tables[-1]
+        tables.append([t0[prev[i] & 0xFF] ^ (prev[i] >> 8) for i in range(256)])
+    return tables
+
+
+_CRC_TABLES = _crc32c_tables()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """CRC-32C (Castagnoli) of `data` -- the checksum RocksDB/Kafka use for
+    their block/record frames; crc32c(b"123456789") == 0xE3069283."""
+    t0, t1, t2, t3, t4, t5, t6, t7 = _CRC_TABLES
+    crc ^= 0xFFFFFFFF
+    n = len(data)
+    mv = memoryview(data)
+    i = 0
+    end8 = n - (n % 8)
+    while i < end8:
+        lo = crc ^ int.from_bytes(mv[i : i + 4], "little")
+        hi = int.from_bytes(mv[i + 4 : i + 8], "little")
+        crc = (
+            t7[lo & 0xFF]
+            ^ t6[(lo >> 8) & 0xFF]
+            ^ t5[(lo >> 16) & 0xFF]
+            ^ t4[(lo >> 24) & 0xFF]
+            ^ t3[hi & 0xFF]
+            ^ t2[(hi >> 8) & 0xFF]
+            ^ t1[(hi >> 16) & 0xFF]
+            ^ t0[(hi >> 24) & 0xFF]
+        )
+        i += 8
+    while i < n:
+        crc = (crc >> 8) ^ t0[(crc ^ data[i]) & 0xFF]
+        i += 1
+    return crc ^ 0xFFFFFFFF
+
+
+def seal_frame(payload: bytes) -> bytes:
+    """Wrap a checkpoint payload in a CRC32C frame:
+    [KCRC][u32 crc][u64 len][payload]."""
+    return CRC_MARKER + _CRC_HEADER.pack(crc32c(payload), len(payload)) + payload
+
+
+def open_frame(data: bytes) -> bytes:
+    """Unwrap (and verify) a sealed frame; legacy unsealed payloads pass
+    through untouched (they begin with a KCT* magic, never KCRC). Raises
+    `CheckpointError` on truncation, length mismatch, or CRC mismatch."""
+    if data[:4] != CRC_MARKER:
+        return data  # legacy unsealed checkpoint
+    if len(data) < 4 + _CRC_HEADER.size:
+        raise CheckpointError("truncated checkpoint CRC header")
+    crc, length = _CRC_HEADER.unpack_from(data, 4)
+    payload = data[4 + _CRC_HEADER.size :]
+    if len(payload) != length:
+        raise CheckpointError(
+            f"checkpoint frame length mismatch (header {length}, "
+            f"payload {len(payload)})"
+        )
+    if crc32c(payload) != crc:
+        raise CheckpointError("checkpoint CRC32C mismatch (corrupt payload)")
+    return payload
+
+
 def read_magic(r: "_Reader") -> int:
     """Consume and validate the 4-byte format tag; returns its version."""
     tag = r._read(4)
@@ -45,7 +135,7 @@ def read_magic(r: "_Reader") -> int:
         return int(MAGIC[3:].decode())
     if tag in COMPAT_MAGIC:
         return int(tag[3:].decode())
-    raise ValueError("bad checkpoint magic")
+    raise CheckpointError("bad checkpoint magic")
 
 
 def upgrade_pool_tree(pool: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
@@ -175,13 +265,25 @@ class _Writer:
 
 class _Reader:
     def __init__(self, data: bytes) -> None:
+        self._data = data
         self._buf = io.BytesIO(data)
 
     def _read(self, n: int) -> bytes:
         out = self._buf.read(n)
         if len(out) != n:
-            raise ValueError("truncated checkpoint frame")
+            raise CheckpointError("truncated checkpoint frame")
         return out
+
+    def expect_end(self) -> None:
+        """Every decode entry point must consume its payload exactly:
+        trailing garbage means a framing bug or a corrupt/foreign blob,
+        and silently ignoring it hides both."""
+        pos = self._buf.tell()
+        if pos != len(self._data):
+            raise CheckpointError(
+                f"checkpoint frame carries {len(self._data) - pos} trailing "
+                "byte(s) past the decoded payload"
+            )
 
     def u8(self) -> int:
         return struct.unpack("<B", self._read(1))[0]
@@ -309,12 +411,12 @@ class CheckpointCodec:
         for topic, offset in snap.latest_offsets.items():
             w.text(topic)
             w.i64(offset)
-        return w.getvalue()
+        return seal_frame(w.getvalue())
 
     def decode_nfa_states(self, data: bytes) -> NFAStates:
         from ..nfa.nfa import ComputationStage
 
-        r = _Reader(data)
+        r = _Reader(open_frame(data))
         read_magic(r)
         n = r.i32()
         queue = []
@@ -345,6 +447,7 @@ class CheckpointCodec:
         for _ in range(r.i32()):
             topic = r.text()
             offsets[topic] = r.i64()
+        r.expect_end()
         return NFAStates(queue, runs, offsets)
 
     # ---------------------------------------------------------------- buffer
@@ -361,10 +464,10 @@ class CheckpointCodec:
             w.text(node.stage_name)
             self._put_event(w, node.event)
             w.i64(node.parent if node.parent is not None else -1)
-        return w.getvalue()
+        return seal_frame(w.getvalue())
 
     def decode_buffer(self, data: bytes) -> SharedVersionedBuffer:
-        r = _Reader(data)
+        r = _Reader(open_frame(data))
         read_magic(r)
         buffer: SharedVersionedBuffer = SharedVersionedBuffer()
         buffer._next_id = r.i64()
@@ -377,6 +480,7 @@ class CheckpointCodec:
             buffer._nodes[node_id] = BufferNode(
                 stage_name, event, None if parent < 0 else parent
             )
+        r.expect_end()
         return buffer
 
     # ------------------------------------------------------------ aggregates
@@ -392,10 +496,10 @@ class CheckpointCodec:
             w.text(name)
             w.i64(sequence)
             w.blob(self._ser(value))
-        return w.getvalue()
+        return seal_frame(w.getvalue())
 
     def decode_aggregates(self, data: bytes) -> AggregatesStore:
-        r = _Reader(data)
+        r = _Reader(open_frame(data))
         read_magic(r)
         store = AggregatesStore()
         for _ in range(r.i32()):
@@ -404,6 +508,7 @@ class CheckpointCodec:
             sequence = r.i64()
             value = self._de(r.blob())
             store.put(key, name, sequence, value)
+        r.expect_end()
         return store
 
     # ---------------------------------------------------- query-level stores
@@ -428,12 +533,12 @@ class CheckpointCodec:
             w.blob(self._ser(key))
             w.blob(self.encode_buffer(buffer))
         w.blob(self.encode_aggregates(aggregates))
-        return w.getvalue()
+        return seal_frame(w.getvalue())
 
     def decode_query_stores(
         self, data: bytes
     ) -> Tuple[NFAStore, BufferStore, AggregatesStore]:
-        r = _Reader(data)
+        r = _Reader(open_frame(data))
         read_magic(r)
         nfa_store = NFAStore()
         for _ in range(r.i32()):
@@ -444,6 +549,7 @@ class CheckpointCodec:
             key = self._de(r.blob())
             buffers.set_for_key(key, self.decode_buffer(r.blob()))
         aggregates = self.decode_aggregates(r.blob())
+        r.expect_end()
         return nfa_store, buffers, aggregates
 
 
@@ -466,13 +572,13 @@ def encode_array_tree(
         for dim in arr.shape:
             w.i64(dim)
         w.blob(arr.tobytes(order="C"))
-    return w.getvalue()
+    return seal_frame(w.getvalue())
 
 
 def decode_array_tree(data: bytes) -> Dict[str, np.ndarray]:
-    r = _Reader(data)
+    r = _Reader(open_frame(data))
     if r._read(4) != MAGIC:
-        raise ValueError("bad checkpoint magic")
+        raise CheckpointError("bad checkpoint magic")
     out: Dict[str, np.ndarray] = {}
     for _ in range(r.i32()):
         name = r.text()
@@ -480,6 +586,7 @@ def decode_array_tree(data: bytes) -> Dict[str, np.ndarray]:
         shape = tuple(r.i64() for _ in range(r.i32()))
         raw = r.blob()
         out[name] = np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+    r.expect_end()
     return out
 
 
@@ -494,7 +601,7 @@ def encode_event_registry(
     for gidx, event in events.items():
         w.i64(gidx)
         codec._put_event(w, event)
-    return w.getvalue()
+    return seal_frame(w.getvalue())
 
 
 def decode_event_registry(
@@ -502,13 +609,14 @@ def decode_event_registry(
     deserialize: Callable[[bytes], Any] = _default_deserialize,
 ) -> Dict[int, Event]:
     codec = _EventOnly(_default_serialize, deserialize)
-    r = _Reader(data)
+    r = _Reader(open_frame(data))
     if r._read(4) != MAGIC:
-        raise ValueError("bad checkpoint magic")
+        raise CheckpointError("bad checkpoint magic")
     out: Dict[int, Event] = {}
     for _ in range(r.i32()):
         gidx = r.i64()
         out[gidx] = codec._get_event(r)
+    r.expect_end()
     return out
 
 

@@ -14,13 +14,17 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Generic, List, Optional, Sequence as Seq, TypeVar, Union
 
 from ..pattern.pattern import Pattern
-from ..state.builders import QueryStoreBuilders
+from ..state.builders import QueryStoreBuilders, changelog_topic
 from ..state.naming import (
     aggregates_store,
+    device_state_store,
+    emitted_store,
     event_buffer_store,
     nfa_states_store,
     normalize_query_name,
 )
+from ..state.nfa_store import EmissionStore
+from .emission import EmissionGate, encode_sink_key
 from .processor import CEPProcessor
 from .serde import Queried
 
@@ -75,10 +79,29 @@ class QueryNode(Generic[K, V]):
         # topology when the caller passes one); the rest of device_opts is
         # tpu-only engine tuning.
         registry = device_opts.pop("registry", None)
-        if runtime == "tpu":
-            from .device_processor import DeviceCEPProcessor
+        self.registry = registry
+        self.device_opts = dict(device_opts)
+        # Exactly-once emission gate (streams/emission.py): its watermark
+        # store rides the same changelog durability stack as the reference
+        # trio, for BOTH runtimes.
+        emit_name = emitted_store(self.name)
+        from ..state.store import (
+            ChangeLoggingKeyValueStore,
+            InMemoryKeyValueStore,
+        )
 
-            self.stores = {}
+        emit_kv: Any = InMemoryKeyValueStore(emit_name)
+        if log is not None:
+            emit_kv = ChangeLoggingKeyValueStore(
+                emit_kv, log, changelog_topic(app_id, emit_name)
+            )
+        self.emission_store = EmissionStore(backing=emit_kv)
+        self.gate = EmissionGate(
+            self.name, store=self.emission_store, registry=registry
+        )
+        if runtime == "tpu":
+            from .device_processor import DeviceCEPProcessor, DeviceStateStore
+
             self.store_builders = None
             self.processor: Any = DeviceCEPProcessor(
                 name,
@@ -87,6 +110,16 @@ class QueryNode(Generic[K, V]):
                 registry=registry,
                 **device_opts,
             )
+            # Device-runtime crash consistency: the engine checkpoint
+            # changelog (snapshotted at every commit's flush) + the
+            # emission watermark, both driven by flush/restore_stores.
+            self.stores = {emit_name: self.emission_store}
+            if log is not None:
+                ds_name = device_state_store(self.name)
+                self.stores[ds_name] = DeviceStateStore(
+                    self, log, changelog_topic(app_id, ds_name),
+                    registry=registry,
+                )
             return
         if runtime != "host":
             raise ValueError(f"unknown runtime {runtime!r} (host|tpu)")
@@ -94,6 +127,7 @@ class QueryNode(Generic[K, V]):
         # processor (QueryStoreBuilders.java:50-56).
         self.store_builders = QueryStoreBuilders(name, pattern)
         self.stores: Dict[str, Any] = self.store_builders.build_all(log, app_id)
+        self.stores[emit_name] = self.emission_store
         self.processor = CEPProcessor(
             name,
             self.store_builders.stages,
@@ -221,12 +255,20 @@ class Topology:
                 outputs.extend(self._emit_device(node, out, results))
             else:
                 for seq in results:
+                    # Dedup gates the DURABLE sink only: in-memory
+                    # consumers (out.records, for_each callbacks) did not
+                    # survive the crash, so a replayed match must still be
+                    # delivered to them -- their guarantee is
+                    # at-least-once across restarts, the sink's is
+                    # exactly-once (README "Failure semantics").
+                    digest = node.gate.admit(key, seq)
                     record = Record(key, seq, timestamp, topic, partition, offset)
                     out.records.append(record)
                     outputs.append(record)
                     for fn in node.downstream:
                         fn(key, seq)
-                    self._sink(node, record)
+                    if digest is not None:
+                        self._sink(node, record, digest)
         return outputs
 
     def flush(self) -> List[Record]:
@@ -248,6 +290,8 @@ class Topology:
         host- and device-runtime outputs carry equivalent context."""
         emitted: List[Record] = []
         for rkey, seq in results:
+            # Dedup gates the durable sink only -- see Topology.process.
+            digest = node.gate.admit(rkey, seq)
             last = seq.matched[-1].events[-1] if seq.matched else None
             record = Record(
                 rkey,
@@ -261,38 +305,69 @@ class Topology:
             emitted.append(record)
             for fn in node.downstream:
                 fn(rkey, seq)
-            self._sink(node, record)
+            if digest is not None:
+                self._sink(node, record, digest)
         return emitted
 
-    def _sink(self, node: QueryNode, record: Record) -> None:
-        """Write a matched record to the node's sink topics in the log."""
+    def _sink(self, node: QueryNode, record: Record, digest: bytes) -> None:
+        """Write a matched record to the node's sink topics in the log.
+
+        The record key carries the match's emission digest
+        (streams/emission.py `encode_sink_key`) so the sink topic itself
+        is the durable record of what it saw -- crash recovery re-reads
+        the tail and dedupes with no cross-topic atomicity."""
         if self.log is None or not node.sink_topics:
             return
-        from ..state.store import default_serializer
         from .serde import sequence_to_json
 
-        key_bytes = default_serializer(record.key)
+        key_bytes = encode_sink_key(record.key, digest)
         value_bytes = sequence_to_json(record.value).encode("utf-8")
         for topic in node.sink_topics:
             self.log.append(
                 topic, key_bytes, value_bytes, timestamp=record.timestamp
             )
 
+    def take_poisoned(self) -> List[tuple]:
+        """Drain every processor's quarantined records ([(query, key,
+        event, exception)]) -- the driver dead-letters them after each
+        poll (streams/driver.py)."""
+        out: List[tuple] = []
+        for _stream, node, _o in self.queries:
+            take = getattr(node.processor, "take_poisoned", None)
+            if take is None:
+                continue
+            out.extend(
+                (node.name, key, event, exc) for key, event, exc in take()
+            )
+        return out
+
     def flush_stores(self) -> None:
         """Flush every query's store stack (pushes cached writes down into
-        the changelog; the reference's commit-interval flush)."""
+        the changelog; the reference's commit-interval flush). The
+        emission gate's watermark rolls forward LAST: a crash between the
+        state appends and the watermark append then leaves NEW state with
+        an OLD watermark -- recovery's sink-tail scan over-covers and the
+        gate harmlessly dedupes. The reverse order (new watermark, old
+        state) would let replay regenerate matches the scan no longer
+        sees, re-opening the duplicate window this gate exists to close."""
         for _stream, node, _out in self.queries:
             for store in node.stores.values():
                 store.flush()
+            node.gate.commit(self.log, node.sink_topics)
+            node.emission_store.flush()
 
     def restore_stores(self) -> int:
         """Replay each store's changelog from the log into the store
-        (the reference's restore-consumer path on rebalance/restart).
-        Returns total changelog records applied."""
+        (the reference's restore-consumer path on rebalance/restart), then
+        recover each query's emission gate from its watermark + the sink
+        tail. Returns total changelog records applied."""
         from ..state.builders import restore_store
 
-        return sum(
+        n = sum(
             restore_store(store)
             for _stream, node, _out in self.queries
             for store in node.stores.values()
         )
+        for _stream, node, _out in self.queries:
+            node.gate.recover(self.log, node.sink_topics)
+        return n

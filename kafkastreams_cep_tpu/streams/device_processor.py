@@ -19,6 +19,7 @@ from typing import Any, Dict, Generic, List, Optional, Tuple, TypeVar
 
 from ..core.event import Event
 from ..core.sequence import Sequence
+from ..faults.injection import CEPOverflowError, PoisonRecords, TransientFault
 from ..ops.engine import EngineConfig
 from ..ops.schema import EventSchema
 from ..ops.tables import CompiledQuery, compile_query
@@ -49,6 +50,7 @@ class DeviceCEPProcessor(Generic[K, V]):
         initial_keys: int = 8,
         mesh: Optional[Any] = None,
         registry: Optional[Any] = None,
+        **engine_opts: Any,
     ) -> None:
         if isinstance(pattern_or_query, CompiledQuery):
             self.query = pattern_or_query
@@ -60,6 +62,9 @@ class DeviceCEPProcessor(Generic[K, V]):
         self.config = config if config is not None else EngineConfig()
         self.batch_size = max(1, batch_size)
         self._capacity = max(1, initial_keys)
+        #: Extra BatchedDeviceNFA knobs (engine=, drain_mode=, ...) --
+        #: retained so checkpoint restore rebuilds the same engine shape.
+        self._engine_opts = dict(engine_opts)
         # `registry` flows into the engine, so the device driver and its
         # engine share one spine; per-query stream counters ride the same
         # registry under the query label.
@@ -69,6 +74,7 @@ class DeviceCEPProcessor(Generic[K, V]):
             config=self.config,
             mesh=mesh,
             registry=registry,
+            **engine_opts,
         )
         self.metrics = self.engine.metrics
         self._m_flushes = self.metrics.counter(
@@ -90,6 +96,10 @@ class DeviceCEPProcessor(Generic[K, V]):
         # Per-(key, topic#partition) high-water mark (CEPProcessor.java:152-160;
         # per-partition for the same reason as streams/processor.py).
         self._hwm: Dict[Tuple[Any, str], int] = {}
+        #: Quarantined records from the flush-time isolation pass (poison
+        #: that only surfaces at pack/predicate-eval time); drained by the
+        #: pipeline above via `take_poisoned()` for dead-lettering.
+        self._poisoned: List[Tuple[Any, Event, Exception]] = []
 
     # ------------------------------------------------------------------ API
     def process(
@@ -149,12 +159,49 @@ class DeviceCEPProcessor(Generic[K, V]):
         self._pending = {}
         self._pending_count = 0
 
+        try:
+            advanced = self.engine.advance(batch)
+        except (CEPOverflowError, TransientFault):
+            raise
+        except Exception:
+            # Poison surfaced at pack/predicate-eval time: the batched
+            # pack is all-or-nothing, so isolate record-by-record -- the
+            # healthy remainder advances, the poison lands in
+            # `self._poisoned` for the driver's DLQ (the pump keeps
+            # advancing; ISSUE 6 quarantine contract).
+            advanced = self._advance_isolating(batch)
         out: List[Tuple[K, Sequence]] = []
-        for lane, seqs in self.engine.advance(batch).items():
+        for lane, seqs in advanced.items():
             out.extend((lane.key, s) for s in seqs)
         self._m_flushes.inc()
         if out:
             self._m_matches.inc(len(out))
+        return out
+
+    def _advance_isolating(
+        self, batch: Dict["_Lane", List[Event]]
+    ) -> Dict["_Lane", List[Sequence]]:
+        """Record-at-a-time fallback after a batch advance raised: each
+        record advances alone (per-lane order preserved); records that
+        still raise are quarantined instead of wedging the pump."""
+        out: Dict[_Lane, List[Sequence]] = {}
+        for lane, events in batch.items():
+            for ev in events:
+                try:
+                    res = self.engine.advance({lane: [ev]})
+                except (CEPOverflowError, TransientFault):
+                    raise
+                except Exception as exc:
+                    self._poisoned.append((lane.key, ev, exc))
+                    continue
+                for l, seqs in res.items():
+                    if seqs:
+                        out.setdefault(l, []).extend(seqs)
+        return out
+
+    def take_poisoned(self) -> List[Tuple[Any, Event, Exception]]:
+        """Hand quarantined records to the caller (clears the buffer)."""
+        out, self._poisoned = self._poisoned, []
         return out
 
     def runs(self, key: K) -> int:
@@ -169,7 +216,7 @@ class DeviceCEPProcessor(Generic[K, V]):
         """Bytes-level checkpoint: engine state + lane map + HWM + pending."""
         import pickle
 
-        from ..state.serde import _Writer, MAGIC, encode_event_registry
+        from ..state.serde import _Writer, MAGIC, encode_event_registry, seal_frame
 
         w = _Writer()
         w._buf.write(MAGIC)
@@ -179,7 +226,7 @@ class DeviceCEPProcessor(Generic[K, V]):
         for key, events in self._pending.items():
             w.blob(pickle.dumps(key, protocol=pickle.HIGHEST_PROTOCOL))
             w.blob(encode_event_registry(dict(enumerate(events))))
-        return w.getvalue()
+        return seal_frame(w.getvalue())
 
     @classmethod
     def restore(
@@ -190,20 +237,30 @@ class DeviceCEPProcessor(Generic[K, V]):
         schema: Optional[EventSchema] = None,
         config: Optional[EngineConfig] = None,
         batch_size: int = 64,
+        initial_keys: int = 8,
         mesh: Optional[Any] = None,
+        registry: Optional[Any] = None,
+        **engine_opts: Any,
     ) -> "DeviceCEPProcessor":
         import pickle
 
-        from ..state.serde import _Reader, decode_event_registry, read_magic
+        from ..state.serde import (
+            _Reader,
+            decode_event_registry,
+            open_frame,
+            read_magic,
+        )
 
         proc = cls(
             query_name, pattern_or_query, schema=schema, config=config,
-            batch_size=batch_size, mesh=mesh,
+            batch_size=batch_size, initial_keys=initial_keys, mesh=mesh,
+            registry=registry, **engine_opts,
         )
-        r = _Reader(data)
+        r = _Reader(open_frame(data))
         read_magic(r)
         proc.engine = BatchedDeviceNFA.restore(
-            proc.query, r.blob(), config=proc.config, mesh=mesh
+            proc.query, r.blob(), config=proc.config, mesh=mesh,
+            registry=registry, **engine_opts,
         )
         proc._capacity = len(proc.engine.keys)
         proc._lane_of_key = {
@@ -218,6 +275,7 @@ class DeviceCEPProcessor(Generic[K, V]):
             events = decode_event_registry(r.blob())
             proc._pending[key] = [events[i] for i in sorted(events)]
             proc._pending_count += len(events)
+        r.expect_end()
         return proc
 
     # ------------------------------------------------------------ internals
@@ -234,6 +292,106 @@ class DeviceCEPProcessor(Generic[K, V]):
         self._next_lane += 1
         self._lane_of_key[key] = lane
         return lane
+
+
+class DeviceStateStore:
+    """Changelog checkpointing for the device runtime (crash consistency).
+
+    The host runtime externalizes per-record snapshots through its three
+    change-logged stores; the device runtime's state is one engine-wide
+    blob, so this store appends the whole `DeviceCEPProcessor.snapshot()`
+    (CRC-sealed by the serde layer) to a changelog topic at every
+    `flush()` -- i.e. at the driver's commit cadence -- and restores the
+    newest snapshot that VALIDATES on `restore_from_changelog()` (torn
+    tails are truncated by the log reload; corrupt payloads fail the CRC
+    and fall back to the previous generation, counted in
+    `cep_checkpoint_corrupt_total`)."""
+
+    def __init__(
+        self, node: Any, log: Any, topic: str, registry: Optional[Any] = None
+    ) -> None:
+        from ..obs.registry import default_registry
+        from ..state.naming import device_state_store
+
+        self.name = device_state_store(node.name)
+        self.node = node
+        self.log = log
+        self.topic = topic
+        self.metrics = registry if registry is not None else default_registry()
+        self._m_corrupt = self.metrics.counter(
+            "cep_checkpoint_corrupt_total",
+            "Checkpoint payloads rejected by CRC/framing validation",
+        )
+
+    @property
+    def persistent(self) -> bool:
+        return True
+
+    def flush(self) -> None:
+        if self.log is None:
+            return
+        self.log.append(self.topic, None, self.node.processor.snapshot())
+
+    def restore_from_changelog(self) -> int:
+        """Rebuild the node's processor from the newest valid snapshot.
+
+        Returns the changelog record count read. Walks backwards past
+        records that fail CRC/framing validation (last-good fallback); a
+        fully-invalid changelog leaves the fresh processor in place --
+        replay from offset zero, never a wedge."""
+        if self.log is None:
+            return 0
+        from ..state.serde import CheckpointError
+
+        recs = self.log.read(self.topic)
+        rejected = 0
+        for rec in reversed(recs):
+            if rec.value is None:
+                continue
+            try:
+                self.node.processor = DeviceCEPProcessor.restore(
+                    self.node.name,
+                    self.node.pattern,
+                    rec.value,
+                    schema=(
+                        self.node.queried.schema
+                        if self.node.queried is not None
+                        else None
+                    ),
+                    registry=self.node.registry,
+                    **self.node.device_opts,
+                )
+                if rejected:
+                    # Last-good fallback succeeded, but the restored state
+                    # is at least one commit older than the committed
+                    # consumer offsets (which rode the SAME commits as the
+                    # rejected snapshots) -- the records in between will
+                    # NOT be reprocessed. Loud, because that gap is data.
+                    import warnings
+
+                    warnings.warn(
+                        f"{self.name}: fell back past {rejected} corrupt "
+                        "device-state snapshot(s); restored state may "
+                        "predate the committed consumer offsets and the "
+                        "gap's records will not be reprocessed",
+                        RuntimeWarning,
+                    )
+                return len(recs)
+            except CheckpointError:
+                rejected += 1
+                self._m_corrupt.inc()
+                continue
+        if rejected:
+            # Snapshots exist but none validates: a fresh engine paired
+            # with already-committed offsets would silently skip the whole
+            # history. Fail the restore instead (the driver's bounded
+            # retry surfaces it via cep_driver_restore_failures_total).
+            raise CheckpointError(
+                f"{self.name}: all {rejected} device-state snapshot(s) "
+                "failed CRC/framing validation; refusing to resume from "
+                "committed offsets with empty engine state"
+            )
+        return len(recs)
 
 
 class _Lane:

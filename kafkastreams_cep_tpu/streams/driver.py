@@ -17,12 +17,22 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..faults import injection as _flt
+from ..faults.injection import CEPOverflowError, TransientFault, with_retry
 from ..obs.registry import MetricsRegistry, default_registry
 from ..state.store import default_deserializer, default_serializer
 from .builder import Topology
 from .log import RecordLog
 
 OFFSETS_TOPIC = "__consumer_offsets"
+
+#: Dead-letter key framing version tag (see LogDriver._dead_letter).
+DLQ_KEY_TAG = "kct-dlq-v1"
+
+
+def dlq_topic(source_topic: str) -> str:
+    """`<source>.DLQ`: the dead-letter topic for one source topic."""
+    return f"{source_topic}.DLQ"
 
 
 def produce(
@@ -65,6 +75,8 @@ class LogDriver:
         registry: Optional[MetricsRegistry] = None,
         report_every_s: Optional[float] = None,
         reporter: Optional[Callable[[str], None]] = None,
+        on_poison: str = "quarantine",
+        max_restore_attempts: int = 3,
     ) -> None:
         self.topology = topology
         self.log = log if log is not None else topology.log
@@ -73,6 +85,15 @@ class LogDriver:
         self.group = group
         self.key_de = key_deserializer
         self.value_de = value_deserializer
+        if on_poison not in ("quarantine", "raise"):
+            raise ValueError(
+                f"on_poison must be quarantine|raise, got {on_poison!r}"
+            )
+        #: Poison policy: "quarantine" (default) dead-letters records that
+        #: fail deserialization or raise inside a predicate and keeps the
+        #: pump advancing; "raise" propagates them (fail-stop).
+        self.on_poison = on_poison
+        self.max_restore_attempts = max(1, max_restore_attempts)
         self.metrics = registry if registry is not None else default_registry()
         # Children bound once to this driver's group (labels() locks per
         # resolution; poll() is the cadence path).
@@ -99,6 +120,17 @@ class LogDriver:
             "cep_driver_reports_total", "Periodic metric reports emitted",
             labels=("group",),
         ).labels(group=self.group)
+        self._m_dead_letters = self.metrics.counter(
+            "cep_driver_dead_letters_total",
+            "Poison records quarantined to the dead-letter topic",
+            labels=("topic", "reason"),
+        )
+        self._m_restore_failures = self.metrics.counter(
+            "cep_driver_restore_failures_total",
+            "Changelog restores that failed after the bounded retries "
+            "(a wedged changelog is visible here, not a hang)",
+            labels=("group",),
+        ).labels(group=self.group)
         self.report_every_s = report_every_s
         self.reporter = reporter
         self._last_report_t = time.perf_counter()
@@ -110,7 +142,26 @@ class LogDriver:
         self.restored_records = 0
         if restore:
             t0 = time.perf_counter()
-            self.restored_records = self.topology.restore_stores()
+
+            def _restore() -> int:
+                if _flt.ACTIVE is not None:
+                    _flt.ACTIVE.fire("driver.restore")
+                return self.topology.restore_stores()
+
+            # Transient-failure wrapper (cep_retries_total{site}) with a
+            # hard cap: a wedged changelog surfaces as a counted failure
+            # plus the final exception, never a silent hang or hot loop.
+            try:
+                self.restored_records = with_retry(
+                    _restore,
+                    site="driver.restore",
+                    attempts=self.max_restore_attempts,
+                    retry_on=(Exception,),
+                    registry=self.metrics,
+                )
+            except Exception:
+                self._m_restore_failures.inc()
+                raise
             self._m_restore_s.set(time.perf_counter() - t0)
             self._m_restored.set(self.restored_records)
         self._load_committed()
@@ -171,14 +222,48 @@ class LogDriver:
                 start = self._positions.get((topic, partition), 0)
                 records = self.log.read(topic, partition, start, budget)
                 for rec in records:
-                    self.topology.process(
-                        topic,
-                        self.key_de(rec.key) if rec.key is not None else None,
-                        self.value_de(rec.value) if rec.value is not None else None,
-                        timestamp=rec.timestamp,
-                        partition=partition,
-                        offset=rec.offset,
-                    )
+                    try:
+                        key = (
+                            self.key_de(rec.key)
+                            if rec.key is not None else None
+                        )
+                        value = (
+                            self.value_de(rec.value)
+                            if rec.value is not None else None
+                        )
+                    except Exception as exc:
+                        # Undeserializable record: quarantine (position
+                        # still advances -- the pump never wedges on
+                        # poison). InjectedCrash is a BaseException, so a
+                        # simulated death can never land here.
+                        self._dead_letter(
+                            topic, partition, rec.offset,
+                            rec.key, rec.value, rec.timestamp,
+                            "deserialize", exc,
+                        )
+                        processed += 1
+                        continue
+                    try:
+                        self.topology.process(
+                            topic,
+                            key,
+                            value,
+                            timestamp=rec.timestamp,
+                            partition=partition,
+                            offset=rec.offset,
+                        )
+                    except (CEPOverflowError, TransientFault):
+                        # Policy escalation / an exhausted transient
+                        # (infrastructure, not data): never misclassify as
+                        # poison -- quarantining it would also silently
+                        # drop the rest of an in-flight device batch.
+                        raise
+                    except Exception as exc:
+                        self._dead_letter(
+                            topic, partition, rec.offset,
+                            rec.key, rec.value, rec.timestamp,
+                            "predicate", exc,
+                        )
                     processed += 1
                 if records:
                     self._positions[(topic, partition)] = records[-1].offset + 1
@@ -189,12 +274,62 @@ class LogDriver:
             if budget is not None and budget <= 0:
                 break
         self.topology.flush()  # flush device micro-batches
+        self._quarantine_flushed()
         if commit and processed:
+            if _flt.ACTIVE is not None:
+                _flt.ACTIVE.fire("driver.pre_commit")
             self.commit()
+            if _flt.ACTIVE is not None:
+                _flt.ACTIVE.fire("driver.post_commit")
         self._m_polls.inc()
         self._m_records.inc(processed)
         self._maybe_report()
         return processed
+
+    # -------------------------------------------------------------- poison
+    def _dead_letter(
+        self,
+        topic: str,
+        partition: int,
+        offset: int,
+        key_bytes: Optional[bytes],
+        value_bytes: Optional[bytes],
+        timestamp: int,
+        reason: str,
+        exc: Exception,
+    ) -> None:
+        """Quarantine one poison record to `<topic>.DLQ` (or re-raise
+        under on_poison="raise"). The DLQ record keeps the original value
+        bytes verbatim; the key frames provenance:
+        (tag, source topic, partition, offset, reason, original key)."""
+        if self.on_poison == "raise":
+            raise exc
+        self.log.append(
+            dlq_topic(topic),
+            default_serializer(
+                (DLQ_KEY_TAG, topic, partition, offset, reason, key_bytes)
+            ),
+            value_bytes,
+            timestamp=timestamp,
+        )
+        self._m_dead_letters.labels(topic=topic, reason=reason).inc()
+
+    def _quarantine_flushed(self) -> None:
+        """Dead-letter records the device runtime quarantined at flush
+        time (poison only detectable at pack/predicate-eval; the original
+        wire bytes are gone by then, so key/value re-serialize through the
+        default serde -- documented in README "Failure semantics")."""
+        for query, _key, event, exc in self.topology.take_poisoned():
+            self._dead_letter(
+                event.topic or query,
+                event.partition,
+                event.offset,
+                default_serializer(event.key),
+                default_serializer(event.value),
+                event.timestamp,
+                "predicate",
+                exc,
+            )
 
     # ---------------------------------------------------------- reporting
     def _maybe_report(self) -> None:

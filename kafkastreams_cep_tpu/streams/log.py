@@ -114,12 +114,28 @@ class RecordLog:
         partition: int = 0,
     ) -> int:
         """Append one record; returns its offset."""
+        from ..faults import injection as _flt
+
         tp = (topic, partition)
         with self._lock:
+            f = self._file_for(tp)
+            if _flt.ACTIVE is not None and f is not None:
+                # `log.torn_append` crash site: the injector lands half the
+                # frame durably and dies BEFORE the in-memory append, so
+                # the reload path (torn-tail truncation above) owns
+                # recovery -- the caller never saw this offset.
+                frame = bytearray(_HEADER.pack(0, timestamp))
+                for blob in (key, value):
+                    if blob is None:
+                        frame += _LEN.pack(-1)
+                    else:
+                        frame += _LEN.pack(len(blob)) + blob
+                _flt.ACTIVE.fire(
+                    "log.torn_append", file=f, payload=bytes(frame)
+                )
             records = self._records.setdefault(tp, [])
             offset = len(records)
             records.append(LogRecord(offset, timestamp, key, value))
-            f = self._file_for(tp)
             if f is not None:
                 f.write(_HEADER.pack(0, timestamp))
                 _write_blob(f, key)
@@ -147,6 +163,14 @@ class RecordLog:
             return sorted(p for (t, p) in self._records if t == topic)
 
     def flush(self) -> None:
+        """Make every buffered append durable.
+
+        Deliberately NOT wrapped in the transient-retry helper: on Linux a
+        failed fsync marks the dirty pages clean, so a retry "succeeds"
+        while the bytes never reached disk (fsyncgate) -- and commit()
+        would then durably record offsets covering lost changelog/sink
+        records. A flush failure here is fail-stop by design; the caller
+        crashes before the offset append and replay recovers."""
         with self._lock:
             for f in self._files.values():
                 f.flush()

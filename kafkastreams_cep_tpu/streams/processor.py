@@ -73,6 +73,12 @@ class CEPProcessor(Generic[K, V]):
             "Records skipped below the high-water mark (at-least-once dedup)",
             labels=("query",),
         ).labels(query=self.query_name)
+        self._m_errors = self.metrics.counter(
+            "cep_processor_errors_total",
+            "Records whose match loop raised (user predicate/fold errors; "
+            "the driver quarantines them to the DLQ)",
+            labels=("query",),
+        ).labels(query=self.query_name)
 
     def _load_nfa(self, key: K) -> Tuple[NFA, NFAStates]:
         snapshot = self.nfa_store.find(key)
@@ -118,7 +124,16 @@ class CEPProcessor(Generic[K, V]):
             return []
 
         event = Event(key, value, timestamp, topic, partition, offset)
-        sequences = nfa.match_pattern(event)
+        try:
+            sequences = nfa.match_pattern(event)
+        except Exception:
+            # A raising user predicate/fold is poison, not a pipeline bug:
+            # count it here (per query) and let the driver quarantine the
+            # record to the DLQ with the pump still advancing. The key's
+            # stored snapshot is untouched (it persists below only on
+            # success), so the next record resumes from pre-poison state.
+            self._m_errors.inc()
+            raise
         self._m_records.inc()
         if sequences:
             self._m_matches.inc(len(sequences))

@@ -42,8 +42,23 @@ TOP_LEVEL: Dict[str, Tuple[bool, tuple]] = {
     "denominator": (True, (str,)),
     "configs": (True, (dict,)),
     "metrics": (True, (dict,)),
+    "faults": (True, (dict,)),
     "schema_ok": (False, (bool,)),
 }
+
+#: The `faults` block (ISSUE 6): label-summed totals of every fault/
+#: robustness counter family (obs/registry.py FAULT_SERIES). All keys
+#: always present; all-zero in a healthy run.
+FAULT_KEYS = (
+    "cep_faults_injected_total",
+    "cep_retries_total",
+    "cep_overflow_backpressure_total",
+    "cep_overflow_dropped_total",
+    "cep_driver_dead_letters_total",
+    "cep_driver_restore_failures_total",
+    "cep_checkpoint_corrupt_total",
+    "cep_emit_deduped_total",
+)
 
 #: The per-component breakdown (ops/profiling.py BatchTimings.components):
 #: all keys always present; tunnel_mbps None until a drain pulled bytes.
@@ -188,6 +203,19 @@ def validate(out: Any) -> List[str]:
                 )
     if isinstance(out.get("metrics"), dict):
         _check_metrics_section(out["metrics"], errors)
+    faults = out.get("faults")
+    if isinstance(faults, dict):
+        for k in FAULT_KEYS:
+            if k not in faults:
+                errors.append(f"faults: missing series {k!r}")
+            elif not isinstance(faults[k], NUMBER):
+                errors.append(f"faults.{k}: expected number")
+        for k in faults:
+            if k not in FAULT_KEYS:
+                errors.append(
+                    f"faults: undocumented series {k!r} (add it to "
+                    "obs.registry.FAULT_SERIES, this schema, and PERF.md)"
+                )
     return errors
 
 

@@ -39,3 +39,14 @@ try:  # private JAX API; guarded so a JAX upgrade degrades gracefully
     _xb._backend_factories.pop("axon", None)
 except Exception:  # pragma: no cover - env-var path still forces cpu
     pass
+
+
+def pytest_configure(config):
+    # `chaos` rides tier-1 (it is NOT `slow`): the seeded fault schedules
+    # are fast, deterministic and CPU-safe, and `pytest -m chaos` selects
+    # just the fault-injection suite (tests/test_faults.py).
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection tests (fast, deterministic, CPU-safe)",
+    )
+    config.addinivalue_line("markers", "slow: excluded from tier-1")

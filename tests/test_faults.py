@@ -1,0 +1,604 @@
+"""Fault-injection proof harness (ISSUE 6): seeded chaos schedules must
+leave the pipeline's match stream identical to the fault-free golden run.
+
+Every named crash site (faults/injection.py) is covered across both step
+engines (xla, pallas_interpret) and both drain modes (flat, pool); the
+driver-level schedules kill the pipeline mid-poll and the harness rebuilds
+it from the durable RecordLog exactly as an operator would restart a
+crashed process. "Identical" is checked on emission digests -- unique per
+match occurrence (streams/emission.py) -- so multiset equality proves
+zero duplicates AND zero losses simultaneously.
+
+All tests are `chaos`-marked (fast, seeded, CPU-safe): `pytest -m chaos`
+selects just this suite, and tier-1 (`-m 'not slow'`) includes it.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu import (
+    ComplexStreamsBuilder,
+    EngineConfig,
+    Event,
+    LogDriver,
+    QueryBuilder,
+    RecordLog,
+    produce,
+)
+from kafkastreams_cep_tpu.faults import (
+    ALL_SITES,
+    CEPOverflowError,
+    FaultInjector,
+    FaultPoint,
+    FaultSchedule,
+    InjectedCrash,
+    TransientFault,
+    armed,
+    with_retry,
+)
+from kafkastreams_cep_tpu.obs.registry import MetricsRegistry
+from kafkastreams_cep_tpu.streams.driver import dlq_topic
+from kafkastreams_cep_tpu.streams.emission import decode_sink_key
+
+pytestmark = pytest.mark.chaos
+
+POISON = "!poison!"
+
+
+def device_pattern():
+    """Expression form (device-compilable) of the same A->B->C query."""
+    from kafkastreams_cep_tpu.pattern.expressions import value
+
+    return (
+        QueryBuilder()
+        .select("select-A").where(value() == "A")
+        .then().select("select-B").where(value() == "B")
+        .then().select("select-C").where(value() == "C")
+        .build()
+    )
+
+
+def letters_pattern():
+    def pred_b(e, s):
+        if e.value == POISON:
+            raise RuntimeError("poison value reached a predicate")
+        return e.value == "B"
+
+    return (
+        QueryBuilder()
+        .select("select-A").where(lambda e, s: e.value == "A")
+        .then().select("select-B").where(pred_b)
+        .then().select("select-C").where(lambda e, s: e.value == "C")
+        .build()
+    )
+
+
+def _stream(seed: int, n: int = 36):
+    """Seeded letter stream with guaranteed complete A->B->C runs: blocks
+    of full matches interleaved with partial-run and noise blocks."""
+    import random
+
+    rng = random.Random(seed)
+    out: list = []
+    while len(out) < n:
+        out.extend(rng.choice(("ABC", "ABC", "AB", "BC", "X", "AXC", "Y")))
+    return out[:n]
+
+
+def _build(log, runtime="host", registry=None, **device_opts):
+    pattern = letters_pattern() if runtime == "host" else device_pattern()
+    builder = ComplexStreamsBuilder(log=log, app_id="chaos")
+    out = (
+        builder.stream("letters")
+        .query("q", pattern, runtime=runtime,
+               registry=registry, **device_opts)
+        .to("matches")
+    )
+    return builder.build(), out
+
+
+def _sink_digests(log):
+    """[(digest, value bytes)] for every sink record -- digests are unique
+    per match occurrence, so multiset equality == no dupes, no losses."""
+    out = []
+    for rec in log.read("matches"):
+        _key, digest = decode_sink_key(rec.key)
+        assert digest is not None
+        out.append((digest, rec.value))
+    return out
+
+
+def _golden(stream, keys=("K",), runtime="host", **device_opts):
+    """The fault-free run's sink content (fresh in-memory log)."""
+    log = RecordLog()
+    for i, ch in enumerate(stream):
+        produce(log, "letters", keys[(i // 6) % len(keys)], ch, timestamp=i)
+    topo, _out = _build(log, runtime=runtime, **device_opts)
+    driver = LogDriver(topo, group="g")
+    while driver.poll(max_records=4):
+        pass
+    return _sink_digests(log)
+
+
+def _chaos(tmp_path, schedule, stream, keys=("K",), runtime="host",
+           max_crashes=24, **device_opts):
+    """Drive the same stream against a durable log with `schedule` armed,
+    rebuilding from disk after every simulated crash; returns the final
+    sink content and the number of crashes survived."""
+    path = str(tmp_path / "wal")
+    log = RecordLog(path)
+    for i, ch in enumerate(stream):
+        produce(log, "letters", keys[(i // 6) % len(keys)], ch, timestamp=i)
+    log.flush()
+    crashes = 0
+    registry = MetricsRegistry()
+    with armed(FaultInjector(schedule, registry=registry)):
+        while True:
+            topo, _out = _build(log, runtime=runtime, **device_opts)
+            try:
+                driver = LogDriver(topo, group="g")
+                while driver.poll(max_records=4):
+                    pass
+                break
+            except InjectedCrash:
+                crashes += 1
+                assert crashes <= max_crashes, "chaos harness did not converge"
+                # Process death: durable bytes survive, objects do not.
+                log.close()
+                log = RecordLog(path)
+    digests = _sink_digests(log)
+    log.close()
+    return digests, crashes
+
+
+def _assert_stream_equal(golden, chaos):
+    """Bitwise match-stream equality: same multiset of (digest, payload),
+    zero duplicate digests."""
+    assert sorted(chaos) == sorted(golden)
+    assert len({d for d, _v in chaos}) == len(chaos), "duplicate emission"
+
+
+# ------------------------------------------------------- seeded schedules
+#: 12 seeded driver-pipeline schedules (plus the explicit-site and engine
+#: matrix runs below): every seed draws 2-3 fault points over the commit
+#: and log crash sites; hit counts keep accumulating across restarts so
+#: one schedule can kill the pipeline several times at different depths.
+DRIVER_SITES = ("driver.pre_commit", "driver.post_commit", "log.torn_append")
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_host_pipeline_seeded_chaos(tmp_path, seed):
+    stream = _stream(seed)
+    golden = _golden(stream)
+    assert golden, "seeded stream must complete matches"
+    schedule = FaultSchedule.seeded(seed, sites=DRIVER_SITES, n_points=3)
+    chaos, crashes = _chaos(tmp_path, schedule, stream)
+    _assert_stream_equal(golden, chaos)
+    # Seeded schedules must actually bite (hit counts are small and the
+    # commit/append sites fire many times per run).
+    assert crashes >= 1
+
+
+@pytest.mark.parametrize("site", DRIVER_SITES)
+def test_host_pipeline_each_driver_site(tmp_path, site):
+    """Every driver-layer crash site, pinned individually (first + a
+    deeper hit) so no site's coverage depends on RNG draws."""
+    stream = _stream(99)
+    golden = _golden(stream)
+    schedule = FaultSchedule(
+        [FaultPoint(site, 1), FaultPoint(site, 3)]
+    )
+    chaos, crashes = _chaos(tmp_path, schedule, stream)
+    _assert_stream_equal(golden, chaos)
+    assert crashes == 2
+
+
+DEVICE_CFG = EngineConfig(lanes=8, nodes=256, matches=256,
+                          matches_per_step=4, nodes_per_step=8)
+DEVICE_OPTS = dict(config=DEVICE_CFG, batch_size=5, initial_keys=2)
+
+
+@pytest.mark.parametrize("engine,drain_mode", [
+    ("xla", "flat"),
+    ("xla", "pool"),
+    ("pallas_interpret", "flat"),
+    ("pallas_interpret", "pool"),
+])
+def test_device_pipeline_engine_matrix(tmp_path, engine, drain_mode):
+    """Crash-consistent recovery on the device runtime across both step
+    engines and both drain modes: mid-flat-drain + pre-commit kills, with
+    the engine checkpoint changelog (DeviceStateStore) driving restore."""
+    stream = _stream(7, n=24 if engine == "xla" else 15)
+    keys = ("k0", "k1")
+    opts = dict(DEVICE_OPTS, engine=engine, drain_mode=drain_mode)
+    golden = _golden(stream, keys=keys, runtime="tpu", **opts)
+    assert golden, "device stream must complete matches"
+    schedule = FaultSchedule(
+        [FaultPoint("engine.mid_drain", 2), FaultPoint("driver.pre_commit", 3)]
+    )
+    chaos, crashes = _chaos(
+        tmp_path, schedule, stream, keys=keys, runtime="tpu", **opts
+    )
+    _assert_stream_equal(golden, chaos)
+    assert crashes == 2
+
+
+@pytest.mark.parametrize("seed", (21, 22))
+def test_device_pipeline_seeded_chaos(tmp_path, seed):
+    stream = _stream(seed, n=30)
+    keys = ("k0", "k1")
+    golden = _golden(stream, keys=keys, runtime="tpu", **DEVICE_OPTS)
+    schedule = FaultSchedule.seeded(
+        seed, sites=DRIVER_SITES + ("engine.mid_drain",), n_points=3
+    )
+    chaos, _crashes = _chaos(
+        tmp_path, schedule, stream, keys=keys, runtime="tpu", **DEVICE_OPTS
+    )
+    _assert_stream_equal(golden, chaos)
+
+
+def test_device_step_transient_retry(tmp_path):
+    """`engine.device_step` transients are absorbed in-process by the
+    retry wrapper: output equals golden, zero crashes, retries counted."""
+    stream = _stream(5, n=20)
+    golden = _golden(stream, keys=("k0",), runtime="tpu", **DEVICE_OPTS)
+    schedule = FaultSchedule(
+        [FaultPoint("engine.device_step", 1),
+         FaultPoint("engine.device_step", 3)]
+    )
+    path = str(tmp_path / "wal")
+    log = RecordLog(path)
+    for i, ch in enumerate(stream):
+        produce(log, "letters", "k0", ch, timestamp=i)
+    registry = MetricsRegistry()
+    topo, _out = _build(log, runtime="tpu", registry=registry, **DEVICE_OPTS)
+    with armed(FaultInjector(schedule)):
+        driver = LogDriver(topo, group="g", registry=registry)
+        while driver.poll(max_records=4):
+            pass
+    assert sorted(_sink_digests(log)) == sorted(golden)
+    retries = registry.get("cep_retries_total")
+    total = sum(c.value for _l, c in retries._sorted_children())
+    assert total >= 2
+
+
+# ------------------------------------------------------------ poison/DLQ
+def test_poison_records_quarantined_host(tmp_path):
+    """Undeserializable bytes and predicate-raising values land in
+    `<source>.DLQ` with the pump still advancing; the healthy remainder
+    of the stream matches the poison-free golden run."""
+    # Golden run: the poison slots hold inert noise ("Y") so every healthy
+    # record keeps the same offset in both runs (identity is offset-based).
+    # Slot 10 (inert "X" mid-stream) becomes raw garbage; slot 14 sits
+    # right after an "A" (a run awaits select-B there, so the raising
+    # predicate actually fires) and before a non-"B" (strict contiguity
+    # kills that run either way, so poison and noise leave equal state).
+    stream = _stream(3)
+    assert stream[13] == "A" and stream[15] != "B"
+    golden_stream = list(stream)
+    golden_stream[10] = golden_stream[14] = "Y"
+    golden = _golden(golden_stream)
+    assert golden
+    log = RecordLog(str(tmp_path / "wal"))
+    offset = 0
+    for i, ch in enumerate(stream):
+        if i == 10:
+            # Raw garbage: fails pickle deserialization at the driver.
+            log.append("letters", b"\x00garbage-key", b"\x00garbage-value",
+                       timestamp=i)
+        elif i == 14:
+            produce(log, "letters", "K", POISON, timestamp=i)
+        else:
+            produce(log, "letters", "K", ch, timestamp=i)
+        offset += 1
+    registry = MetricsRegistry()
+    topo, _out = _build(log, registry=registry)
+    driver = LogDriver(topo, group="g", registry=registry)
+    while driver.poll(max_records=4):
+        pass
+    _assert_stream_equal(golden, _sink_digests(log))
+    dlq = log.read(dlq_topic("letters"))
+    assert len(dlq) == 2
+    assert dlq[0].value == b"\x00garbage-value"
+    dead = registry.get("cep_driver_dead_letters_total")
+    by_reason = {
+        dict(_lv for _lv in zip(dead.label_names, lv))["reason"]: c.value
+        for lv, c in dead._sorted_children()
+    }
+    assert by_reason == {"deserialize": 1.0, "predicate": 1.0}
+    # All source records consumed: the poison did not wedge the position.
+    assert driver.position("letters") == offset
+
+
+def test_poison_record_quarantined_device(tmp_path):
+    """Device runtime: poison only surfaces at pack time (schema
+    tokenization of an unpackable value); the flush-level isolation pass
+    quarantines exactly the poison record and the rest of the batch still
+    matches."""
+    stream = _stream(13, n=20)
+    # The poison replaces an inert slot whose predecessor ends every
+    # partial run (strict contiguity), so "record quarantined" and
+    # "record was noise" leave identical engine state -- golden (noise in
+    # that slot) and chaos (poison there) stay offset-aligned.
+    slot = next(
+        i for i in range(2, len(stream) - 1)
+        if stream[i - 1] in ("C", "X", "Y") and stream[i] not in ("A", "B", "C")
+    )
+    golden = _golden(stream, keys=("k0",), runtime="tpu", **DEVICE_OPTS)
+    log = RecordLog(str(tmp_path / "wal"))
+    for i, ch in enumerate(stream):
+        if i == slot:
+            # Unhashable value: schema vocab tokenization raises at pack.
+            produce(log, "letters", "k0", ["unpackable"], timestamp=i)
+        else:
+            produce(log, "letters", "k0", ch, timestamp=i)
+    registry = MetricsRegistry()
+    topo, _out = _build(log, runtime="tpu", registry=registry, **DEVICE_OPTS)
+    driver = LogDriver(topo, group="g", registry=registry)
+    while driver.poll(max_records=4):
+        pass
+    _assert_stream_equal(golden, _sink_digests(log))
+    dlq = log.read(dlq_topic("letters"))
+    assert len(dlq) == 1
+
+
+def test_on_poison_raise_propagates(tmp_path):
+    log = RecordLog()
+    log.append("letters", b"\x00garbage", b"\x00garbage")
+    topo, _out = _build(log)
+    driver = LogDriver(topo, group="g", on_poison="raise")
+    with pytest.raises(Exception):
+        driver.poll()
+
+
+# ------------------------------------------------- checkpoint integrity
+def test_checkpoint_file_crash_falls_back_to_last_good(tmp_path):
+    from kafkastreams_cep_tpu.state.serde import CheckpointError
+    from kafkastreams_cep_tpu.state.store import CheckpointFile
+
+    registry = MetricsRegistry()
+    ckpt = CheckpointFile(str(tmp_path / "ck" / "engine.ckpt"),
+                          registry=registry)
+    ckpt.save(b"KCT5-generation-one")
+    ckpt.save(b"KCT5-generation-two")
+    assert ckpt.load() == b"KCT5-generation-two"
+    # Crash mid-write: the injector lands torn bytes on the final path.
+    schedule = FaultSchedule([FaultPoint("store.checkpoint_write", 1)])
+    with armed(FaultInjector(schedule)):
+        with pytest.raises(InjectedCrash):
+            ckpt.save(b"KCT5-generation-three")
+    # The simulated corruption tore generation-two's file in place, so
+    # the CRC rejects it and the retained previous generation wins.
+    assert ckpt.load() == b"KCT5-generation-one"
+    assert registry.get("cep_checkpoint_corrupt_total").value >= 1
+    # A fully corrupt pair raises the typed error.
+    for path in (ckpt.path, ckpt.prev_path):
+        with open(path, "wb") as f:
+            f.write(b"KCRC\x00\x01garbage")
+    with pytest.raises(CheckpointError):
+        ckpt.load()
+
+
+def test_serde_rejects_trailing_garbage_and_corruption():
+    from kafkastreams_cep_tpu.pattern.compiler import ensure_stages
+    from kafkastreams_cep_tpu.state.serde import (
+        CheckpointError,
+        CheckpointCodec,
+        decode_array_tree,
+        encode_array_tree,
+    )
+    from kafkastreams_cep_tpu.state.nfa_store import NFAStates
+
+    codec = CheckpointCodec(ensure_stages(letters_pattern()))
+    blob = codec.encode_nfa_states(NFAStates([], 1, {"t#0": 5}))
+    assert codec.decode_nfa_states(blob).runs == 1
+    # Trailing garbage inside the sealed payload must be rejected, not
+    # silently ignored (satellite: full-consumption assertion).
+    from kafkastreams_cep_tpu.state.serde import open_frame, seal_frame
+
+    resealed = seal_frame(open_frame(blob) + b"trailing-junk")
+    with pytest.raises(CheckpointError):
+        codec.decode_nfa_states(resealed)
+    # Legacy unsealed payloads still decode (back-compat)...
+    assert codec.decode_nfa_states(open_frame(blob)).runs == 1
+    # ...and bit-flips inside a sealed frame fail the CRC.
+    flipped = bytearray(blob)
+    flipped[-1] ^= 0xFF
+    with pytest.raises(CheckpointError):
+        codec.decode_nfa_states(bytes(flipped))
+    # Truncation of a typed array tree is a CheckpointError too.
+    tree = encode_array_tree({"a": np.arange(8, dtype=np.int32)})
+    with pytest.raises(CheckpointError):
+        decode_array_tree(tree[: len(tree) - 3])
+
+
+# ----------------------------------------------------- overflow policies
+def _overflow_engine(policy, matches=8):
+    from kafkastreams_cep_tpu.ops.tables import compile_query
+    from kafkastreams_cep_tpu.parallel.batched import BatchedDeviceNFA
+    from kafkastreams_cep_tpu.pattern.compiler import compile_pattern
+
+    query = compile_query(compile_pattern(device_pattern()), None)
+    return BatchedDeviceNFA(
+        query,
+        keys=["x", "y"],
+        config=EngineConfig(lanes=8, nodes=256, matches=matches,
+                            matches_per_step=4, on_overflow=policy),
+        registry=MetricsRegistry(),
+    )
+
+
+def _matchy_events(key, n_batches, t=4, start=0):
+    """Batches of ABCA BCAB ... -- a completed match every 3 events."""
+    cycle = "ABC"
+    batches = []
+    for b in range(n_batches):
+        evs = [
+            Event(key, cycle[(b * t + i) % 3], 1000 + start + b * t + i,
+                  "t", 0, start + b * t + i)
+            for i in range(t)
+        ]
+        batches.append(evs)
+    return batches
+
+
+def test_overflow_block_backpressure_zero_drops():
+    """Capacity stress with on_overflow="block": the tiny ring (8 slots,
+    step_cap 16 > ring) would silently drop under "drop" with deferred
+    decode; "block" forces early drains and finishes loss-free with
+    nonzero backpressure. Output remains bitwise equal to a comfortably
+    sized fault-free engine."""
+    golden_eng = _overflow_engine("drop", matches=1024)
+    blocked = _overflow_engine("block", matches=8)
+    dropped = _overflow_engine("drop", matches=8)
+    golden_out, block_out, drop_out = [], [], []
+    for eng, sink in ((golden_eng, golden_out), (blocked, block_out),
+                      (dropped, drop_out)):
+        for key in ("x", "y"):
+            for evs in _matchy_events(key, 9):
+                eng.advance_packed(eng.pack({key: evs}), decode=False)
+        for k, seqs in sorted(eng.drain().items(), key=lambda kv: str(kv[0])):
+            sink.extend((k, tuple(tuple(s.events) for s in seq.matched))
+                        for seq in seqs)
+    # The stress is real: the same sizing under "drop" loses matches.
+    assert dropped.stats["match_drops"] > 0
+    assert len(drop_out) < len(golden_out)
+    # "block" loses nothing and surfaced the backpressure.
+    assert blocked.stats["match_drops"] == 0
+    assert blocked.stats["node_drops"] == 0
+    assert sorted(block_out) == sorted(golden_out)
+    bp = blocked.metrics.get("cep_overflow_backpressure_total")
+    assert bp is not None and bp.value > 0
+    # The loud-drop counters made the "drop" run's loss visible too.
+    loud = dropped.metrics.get("cep_overflow_dropped_total")
+    total = sum(c.value for _l, c in loud._sorted_children())
+    assert total > 0
+
+
+def test_overflow_raise_escalates():
+    eng = _overflow_engine("raise", matches=8)
+    with pytest.raises(CEPOverflowError):
+        for evs in _matchy_events("x", 9):
+            eng.advance_packed(eng.pack({"x": evs}), decode=False)
+        eng.drain()
+
+
+def test_ledger_overflow_routed_through_policy():
+    """Satellite: the replay-ledger overflow warning escalates under
+    "raise" while its persistent-gauge behavior stays pinned."""
+    for policy, should_raise in (("drop", False), ("raise", True)):
+        eng = _overflow_engine(policy, matches=1024)
+        # Arm the replay ledger manually (the letters query has no folds,
+        # so replay is normally disarmed) and shrink the bound.
+        eng.exact_replay = True
+        eng._snap = (eng.state, eng.pool)
+        eng.REPLAY_LEDGER_MAX_BATCHES = 1
+        batches = _matchy_events("x", 3)
+
+        def _run(eng=eng, batches=batches):
+            for evs in batches:
+                xs = eng.pack({"x": evs})
+                eng.advance_packed(xs, decode=False)
+
+        with pytest.warns(RuntimeWarning, match="ledger"):
+            if should_raise:
+                with pytest.raises(CEPOverflowError):
+                    _run()
+            else:
+                _run()
+        snap = eng.metrics.snapshot()
+        assert snap["cep_replay_ledger_overflow"]["values"][0]["value"] == 1
+
+
+# ------------------------------------------------ exactly-once + hygiene
+def test_emission_gate_survives_uncommitted_sink_writes(tmp_path):
+    """The core exactly-once window: matches reach the sink but the crash
+    lands before the offsets commit -- replay must not double-emit."""
+    stream = _stream(17)
+    golden = _golden(stream)
+    path = str(tmp_path / "wal")
+    log = RecordLog(path)
+    for i, ch in enumerate(stream):
+        produce(log, "letters", "K", ch, timestamp=i)
+    topo, _out = _build(log)
+    driver = LogDriver(topo, group="g")
+    # Process everything, flush sink appends durably, never commit.
+    driver.poll(commit=False)
+    log.close()  # crash after the sink writes became durable
+    log2 = RecordLog(path)
+    topo2, _out2 = _build(log2)
+    driver2 = LogDriver(topo2, group="g")
+    while driver2.poll(max_records=4):
+        pass
+    _assert_stream_equal(golden, _sink_digests(log2))
+    log2.close()
+
+
+def test_disarmed_hooks_keep_advance_async(monkeypatch):
+    """Acceptance pin (PR 5 style): with no injector armed and the default
+    overflow policy, decode=False advances stay fully async -- the fault
+    hooks and policy checks add zero device syncs to the hot path."""
+    import jax as jax_mod
+
+    from kafkastreams_cep_tpu.faults import injection as _flt
+
+    assert _flt.ACTIVE is None
+    eng = _overflow_engine("drop", matches=1024)
+    # Warm every jitted program outside the counted window.
+    eng.advance({"x": [Event("x", v, 1000 + i, "t", 0, i)
+                       for i, v in enumerate("ABC")]})
+    calls = {"block": 0, "get": 0, "pull": 0}
+    real_block = jax_mod.block_until_ready
+    monkeypatch.setattr(
+        jax_mod, "block_until_ready",
+        lambda *a, **k: calls.__setitem__("block", calls["block"] + 1)
+        or real_block(*a, **k),
+    )
+    real_get = jax_mod.device_get
+    monkeypatch.setattr(
+        jax_mod, "device_get",
+        lambda *a, **k: calls.__setitem__("get", calls["get"] + 1)
+        or real_get(*a, **k),
+    )
+    real_pull = eng._pull_raw
+    monkeypatch.setattr(
+        eng, "_pull_raw",
+        lambda: calls.__setitem__("pull", calls["pull"] + 1) or real_pull(),
+    )
+    for b in range(4):
+        xs = eng.pack({"x": [Event("x", "Z", 2000 + 10 * b + i, "t", 0,
+                                   100 + 10 * b + i) for i in range(4)]})
+        eng.advance_packed(xs, decode=False)
+    assert calls == {"block": 0, "get": 0, "pull": 0}
+
+
+def test_with_retry_counts_and_reraises():
+    registry = MetricsRegistry()
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise TransientFault("engine.device_step")
+        return "ok"
+
+    assert with_retry(flaky, site="engine.device_step",
+                      registry=registry) == "ok"
+    counter = registry.get("cep_retries_total")
+    total = sum(c.value for _l, c in counter._sorted_children())
+    assert total == 2
+    # Exhausted retries re-raise the last failure.
+    with pytest.raises(TransientFault):
+        with_retry(lambda: (_ for _ in ()).throw(TransientFault("x" )),
+                   site="engine.device_step", attempts=2,
+                   retry_on=(TransientFault,), registry=registry)
+
+
+def test_schedule_seeding_is_deterministic():
+    a = FaultSchedule.seeded(42, sites=ALL_SITES, n_points=4)
+    b = FaultSchedule.seeded(42, sites=ALL_SITES, n_points=4)
+    assert [(p.site, p.hit) for p in a.points] == [
+        (p.site, p.hit) for p in b.points
+    ]
+    assert all(p.site in ALL_SITES for p in a.points)

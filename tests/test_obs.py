@@ -29,6 +29,7 @@ from kafkastreams_cep_tpu.core.event import Event
 from kafkastreams_cep_tpu.obs import (
     MetricsRegistry,
     SpanTracer,
+    fault_series_totals,
     parse_prom_text,
     registry_from_snapshot,
 )
@@ -456,6 +457,9 @@ def _valid_artifact():
         "denominator": "python_host_port_no_jvm_available",
         "configs": {"skip_any8_batched": {"components": dict(components)}},
         "metrics": reg.snapshot(),
+        # ISSUE 6: the fault/robustness block -- all FAULT_SERIES keys,
+        # all-zero in a healthy artifact.
+        "faults": fault_series_totals(MetricsRegistry()),
     }
 
 

@@ -293,6 +293,64 @@ def test_log_driver_crash_resume_matches_unbroken_run(tmp_path):
     log2.close()
 
 
+def test_log_driver_crash_between_process_and_commit_exactly_once(tmp_path):
+    """A crash after records were processed (matches flushed to the sink)
+    but before the offset commit used to replay the interval and re-emit:
+    the emitted-match high-watermark (streams/emission.py) must make the
+    sink stream exactly-once -- same records as the unbroken run, zero
+    duplicates (ISSUE 6)."""
+    from kafkastreams_cep_tpu.faults import (
+        FaultInjector,
+        FaultPoint,
+        FaultSchedule,
+        InjectedCrash,
+        armed,
+    )
+    from kafkastreams_cep_tpu.streams.emission import decode_sink_key
+
+    stream = "ABCXABCABC"
+
+    # Unbroken run: the golden sink content.
+    mem = RecordLog()
+    for i, ch in enumerate(stream):
+        produce(mem, "letters", "K", ch, timestamp=i)
+    topo_u, _out_u = _build_topology(mem)
+    LogDriver(topo_u, group="g").poll()
+    golden = sorted(
+        (decode_sink_key(r.key)[1], r.value) for r in mem.read("matches")
+    )
+    assert len(golden) == 3
+
+    # Crash exactly between process and commit, twice, at different depths.
+    path = str(tmp_path / "wal")
+    log = RecordLog(path)
+    for i, ch in enumerate(stream):
+        produce(log, "letters", "K", ch, timestamp=i)
+    log.flush()
+    schedule = FaultSchedule(
+        [FaultPoint("driver.pre_commit", 1), FaultPoint("driver.pre_commit", 2)]
+    )
+    crashes = 0
+    with armed(FaultInjector(schedule)):
+        while True:
+            topo, _out = _build_topology(log)
+            try:
+                driver = LogDriver(topo, group="g")
+                while driver.poll(max_records=4):
+                    pass
+                break
+            except InjectedCrash:
+                crashes += 1
+                log.close()
+                log = RecordLog(path)
+    assert crashes == 2
+    final = sorted(
+        (decode_sink_key(r.key)[1], r.value) for r in log.read("matches")
+    )
+    assert final == golden  # zero losses AND zero duplicates
+    log.close()
+
+
 def test_log_driver_commit_offsets_topic():
     log = RecordLog()
     produce(log, "letters", "K", "A")
