@@ -119,6 +119,13 @@ TS0 = 1_000_000
 #: exempt (no tunnel; tiny pulls make MB/s meaningless there).
 TUNNEL_FLOOR_MBPS = 50.0
 
+#: Provenance-sampling rate armed on the flagship batched engine and the
+#: smoke introspection pipeline (ISSUE 7): the artifact's `observation`
+#: block records it so BENCH_r* self-describes the observation overhead
+#: (sampling rides the decode worker; the advance path stays zero-sync,
+#: pinned by tests/test_obs.py).
+PROVENANCE_SAMPLE = 0.01
+
 
 def log(msg: str) -> None:
     print(f"[bench +{time.perf_counter() - _T_START:7.1f}s] {msg}", file=sys.stderr, flush=True)
@@ -348,7 +355,7 @@ def bench_device_batched(
     query = compile_query(compile_pattern(pattern_fn()), schema)
     bat = BatchedDeviceNFA(
         query, keys=[f"k{i}" for i in range(n_keys)], config=config,
-        engine=ARGS.engine,
+        engine=ARGS.engine, provenance_sample=PROVENANCE_SAMPLE,
     )
     rng = random.Random(7)
     n_warm = 2  # warmup batches (compiles incl. a match-bearing drain)
@@ -611,6 +618,121 @@ def bench_multi_query(
     )
 
 
+def bench_introspection() -> Dict[str, Any]:
+    """Smoke-only live-plane pass (ISSUE 7): a real durable pipeline
+    (letters query, tpu runtime, provenance sampling armed) served over
+    the stdlib HTTP introspection plane MID-RUN. Verifies, end to end:
+
+    - /metrics, /snapshot, /healthz and /tracez answer while the stream
+      is flowing (the acceptance's curl-mid-stream contract);
+    - after the run, the SERVED prom text value-matches the final JSON
+      snapshot (wire view == artifact view -- the reporter is disarmed
+      first so no counter moves between the fetch and the snapshot);
+    - the end-to-end match-latency histogram (ingest stamp at driver
+      poll -> sink emission) and the sampled provenance exemplars
+      populated.
+
+    Returns the detail block; the artifact's top-level `latency` and
+    `observation` entries derive from it."""
+    import urllib.request
+
+    from kafkastreams_cep_tpu import (
+        ComplexStreamsBuilder,
+        LogDriver,
+        RecordLog,
+        produce,
+    )
+    from kafkastreams_cep_tpu.obs import MetricsRegistry, registry_from_snapshot
+
+    reg = MetricsRegistry()
+    rlog = RecordLog()
+    builder = ComplexStreamsBuilder(log=rlog, app_id="bench-introspect")
+    builder.stream("letters").query(
+        "q-intro", letters_pattern(), runtime="tpu", registry=reg,
+        batch_size=8, initial_keys=2,
+        config=EngineConfig(lanes=8, nodes=256, matches=64),
+        # Sample EVERY match here: the smoke must observe exemplars
+        # actually flowing (the flagship engine runs the production
+        # PROVENANCE_SAMPLE rate; this CI pipeline proves the path).
+        provenance_sample=1.0,
+    ).to("matches")
+    topo = builder.build()
+    driver = LogDriver(
+        topo, group="bench-intro", registry=reg,
+        report_every_s=0.05, reporter=lambda text: None,
+    )
+    srv = driver.serve_http()
+    rng = random.Random(5)
+    stream = letters_stream(rng, 128)
+    mid_routes: Dict[str, int] = {}
+    endpoints_ok = True
+    served_matches_snapshot = False
+    t0 = time.perf_counter()
+    try:
+        for e in stream[:64]:
+            produce(rlog, "letters", e.key, e.value, timestamp=e.timestamp)
+        driver.poll()
+        # Curl mid-run: every route must answer while records remain.
+        for route in (
+            "/metrics", "/snapshot", "/healthz", "/tracez",
+            "/tracez?kind=match",
+        ):
+            try:
+                body = urllib.request.urlopen(
+                    srv.url + route, timeout=10
+                ).read()
+                mid_routes[route] = len(body)
+                endpoints_ok = endpoints_ok and len(body) > 0
+            except Exception as exc:
+                log(f"introspection route {route} failed: {exc}")
+                endpoints_ok = False
+        for e in stream[64:]:
+            produce(rlog, "letters", e.key, e.value, timestamp=e.timestamp)
+        driver.poll()
+        # Disarm the periodic reporter (with its quiesce barrier) so no
+        # counter moves between the served fetch and the final snapshot,
+        # then prove wire == JSON.
+        driver.disarm_reporter()
+        served = urllib.request.urlopen(
+            srv.url + "/metrics", timeout=10
+        ).read().decode("utf-8")
+        final_snap = reg.snapshot()
+        served_matches_snapshot = (
+            registry_from_snapshot(final_snap).to_prom_text() == served
+        )
+    finally:
+        srv.stop()
+    dt = time.perf_counter() - t0
+
+    lat_block = None
+    fam = reg.get("cep_match_latency_seconds")
+    snap_vals = final_snap.get("cep_match_latency_seconds", {}).get("values")
+    if fam is not None and snap_vals:
+        snap_fam = snap_vals[0]
+        child = fam.labels(**snap_fam["labels"])
+        p50 = child.percentile(50)
+        p99 = child.percentile(99)
+        lat_block = {
+            "query": snap_fam["labels"].get("query", "q-intro"),
+            "count": int(snap_fam["count"]),
+            "sum_s": float(snap_fam["sum"]),
+            "p50_ms": None if p50 is None else p50 * 1e3,
+            "p99_ms": None if p99 is None else p99 * 1e3,
+            "buckets": dict(snap_fam["buckets"]),
+        }
+    n_exemplars = len(driver.match_exemplars(256))
+    return dict(
+        events=len(stream), seconds=dt, eps=len(stream) / dt,
+        provenance_sample=1.0,
+        http_routes=mid_routes,
+        http_endpoints_ok=endpoints_ok,
+        served_matches_snapshot=served_matches_snapshot,
+        provenance_exemplars=n_exemplars,
+        match_latency=lat_block,
+        metrics=final_snap,
+    )
+
+
 def _fault_block(flagship_metrics: Dict[str, Any]) -> Dict[str, float]:
     """The artifact's `faults` block: FAULT_SERIES totals summed over the
     flagship engine's registry snapshot and the process-default registry
@@ -789,6 +911,19 @@ def main() -> None:
                 f"gc_group_sweep post_ms/advance {sweep['post_ms']} "
                 f"monotone={sweep['monotone_decreasing']}"
             )
+            # Live introspection pass (ISSUE 7 acceptance): serve the
+            # plane over a real pipeline mid-run, prove wire == snapshot,
+            # and source the artifact's `latency` block.
+            log("introspection (HTTP plane mid-run, latency, provenance)")
+            intro = bench_introspection()
+            detail["introspection"] = intro
+            log(
+                f"introspection: endpoints_ok={intro['http_endpoints_ok']} "
+                f"served==snapshot {intro['served_matches_snapshot']} "
+                f"exemplars {intro['provenance_exemplars']} "
+                f"latency_count "
+                f"{(intro['match_latency'] or {}).get('count')}"
+            )
         # Config 4: N concurrent queries over one stream.
         log("multi_query (config 4)")
         detail["multi_query"] = bench_multi_query(
@@ -822,6 +957,20 @@ def main() -> None:
     # The flagship engine's registry exposition rides the top level (the
     # other configs' snapshots stay under their own detail dicts).
     flagship_metrics = detail.get("skip_any8_batched", {}).pop("metrics", {})
+    # Cross-registry merge (ISSUE 7): the flagship engine registry and the
+    # introspection pipeline's registry combined into ONE exposition via
+    # obs/merge.py (counters sum, gauges pick up a `device` label,
+    # histograms merge bucket-wise); check_bench_schema round-trips it
+    # like the primary `metrics` section.
+    intro_detail = detail.get("introspection") or {}
+    intro_metrics = intro_detail.pop("metrics", {}) if intro_detail else {}
+    metrics_merged = None
+    if flagship_metrics and intro_metrics:
+        from kafkastreams_cep_tpu.obs.merge import merge_snapshots
+
+        metrics_merged = merge_snapshots(
+            {"engine": flagship_metrics, "pipeline": intro_metrics}
+        )
     out = {
         "metric": "events_per_sec_skip_any8_batched",
         "value": round(headline, 1),
@@ -842,6 +991,28 @@ def main() -> None:
         "latency_p99_match_emit_ms": detail.get("skip_any8_latency", {}).get(
             "p99_match_emit_ms"
         ),
+        # End-to-end match-latency histogram (ISSUE 7): ingest stamp at
+        # driver poll -> sink emission, from the smoke introspection
+        # pipeline (None outside --smoke: the full bench drives engines
+        # directly, not a LogDriver pipeline).
+        "latency": intro_detail.get("match_latency"),
+        # Observation-overhead self-description (ISSUE 7): what telemetry
+        # was armed while the numbers were taken.
+        "observation": {
+            "provenance_sample": PROVENANCE_SAMPLE,
+            "http_server": bool(ARGS.smoke),
+            "http_endpoints_ok": (
+                intro_detail.get("http_endpoints_ok")
+                if ARGS.smoke else None
+            ),
+            "served_matches_snapshot": (
+                intro_detail.get("served_matches_snapshot")
+                if ARGS.smoke else None
+            ),
+        },
+        # The merged cross-registry exposition (obs/merge.py), None
+        # outside --smoke.
+        "metrics_merged": metrics_merged,
         "platform": platform,
         "quick": quick,
         # No JVM is provisionable in this zero-egress image: the baseline

@@ -9,12 +9,91 @@ event. On the device path, sequences are decoded from compact
 """
 from __future__ import annotations
 
-from typing import Dict, Generic, Iterator, List, Optional, Tuple, TypeVar
+from typing import Any, Dict, Generic, Iterator, List, Optional, Tuple, TypeVar
 
 from .event import Event
 
 K = TypeVar("K")
 V = TypeVar("V")
+
+
+class MatchProvenance:
+    """Why this match fired: the lineage of one decoded Sequence.
+
+    The NFA^b design's point (Agrawal et al., SIGMOD'08; NFA.java:51-52)
+    is that a match is a traceable pointer chain through the shared
+    versioned buffer with a Dewey version path -- this struct is that
+    trace, decoded from the already-pulled chain table at no extra device
+    cost (ISSUE 7):
+
+    - `stage_path`: stage names in traversal order (the pointer chain's
+      stage walk, oldest first);
+    - `chain_depth`: total events on the chain (hops in the buffer walk);
+    - `branch_depth`: the Dewey-style version-path depth -- one digit per
+      stage the run entered (DeweyVersion.add_stage per transition), i.e.
+      len(stage_path);
+    - `first_offset`/`last_offset`, `first_timestamp`/`last_timestamp`:
+      the window span the match covered, in source-log coordinates;
+    - `query`: owning query name; `trigger`: the drain that emitted it
+      (drain | ring_full | region_pressure | micro_drain | backpressure).
+    """
+
+    __slots__ = (
+        "query",
+        "trigger",
+        "stage_path",
+        "chain_depth",
+        "branch_depth",
+        "first_offset",
+        "last_offset",
+        "first_timestamp",
+        "last_timestamp",
+    )
+
+    def __init__(
+        self,
+        query: str,
+        trigger: str,
+        stage_path: Tuple[str, ...],
+        chain_depth: int,
+        branch_depth: int,
+        first_offset: int,
+        last_offset: int,
+        first_timestamp: int,
+        last_timestamp: int,
+    ) -> None:
+        self.query = query
+        self.trigger = trigger
+        self.stage_path = tuple(stage_path)
+        self.chain_depth = chain_depth
+        self.branch_depth = branch_depth
+        self.first_offset = first_offset
+        self.last_offset = last_offset
+        self.first_timestamp = first_timestamp
+        self.last_timestamp = last_timestamp
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-friendly form (the /tracez?kind=match wire shape)."""
+        return {
+            "query": self.query,
+            "trigger": self.trigger,
+            "stage_path": list(self.stage_path),
+            "chain_depth": self.chain_depth,
+            "branch_depth": self.branch_depth,
+            "first_offset": self.first_offset,
+            "last_offset": self.last_offset,
+            "first_timestamp": self.first_timestamp,
+            "last_timestamp": self.last_timestamp,
+        }
+
+    def __repr__(self) -> str:
+        return (
+            f"MatchProvenance(query={self.query!r}, trigger={self.trigger!r}, "
+            f"stages={'>'.join(self.stage_path)}, depth={self.chain_depth}, "
+            f"branch={self.branch_depth}, "
+            f"offsets=[{self.first_offset}, {self.last_offset}], "
+            f"ts=[{self.first_timestamp}, {self.last_timestamp}])"
+        )
 
 
 class Staged(Generic[K, V]):
@@ -49,6 +128,14 @@ class Staged(Generic[K, V]):
 
 class Sequence(Generic[K, V]):
     """An ordered collection of per-stage matched event groups."""
+
+    #: Sampled lineage (MatchProvenance) attached by the decode path when
+    #: provenance sampling is armed; None otherwise. A CLASS default, not
+    #: an __init__ assignment: the native decoder (decoder.cc) builds
+    #: instances without running Python __init__, and the accessor must
+    #: hold there too. Deliberately outside __eq__/__hash__: two equal
+    #: matches stay equal whether or not one was sampled.
+    provenance: Optional[MatchProvenance] = None
 
     def __init__(self, matched: List[Staged[K, V]]) -> None:
         self.matched: List[Staged[K, V]] = list(matched)

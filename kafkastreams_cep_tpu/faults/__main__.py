@@ -29,6 +29,12 @@ def main(argv=None) -> int:
     ap.add_argument("--runtime", default="host", choices=["host", "tpu"])
     ap.add_argument("--events", type=int, default=48, help="stream length")
     ap.add_argument("--points", type=int, default=3, help="faults per seed")
+    ap.add_argument(
+        "--http-port", type=int, default=None, metavar="PORT",
+        help="serve the live introspection plane (/metrics /snapshot "
+        "/healthz /tracez) over the process-default registry while the "
+        "soak runs; 0 binds an ephemeral port (printed)",
+    )
     args = ap.parse_args(argv)
 
     import jax
@@ -58,6 +64,33 @@ def main(argv=None) -> int:
     opts = dict(DEVICE_OPTS) if args.runtime == "tpu" else {}
     keys = ("k0", "k1") if args.runtime == "tpu" else ("K",)
     failures = 0
+    progress = {"seed": None, "done": 0, "failures": 0}
+    server = None
+    tracer = None
+    if args.http_port is not None:
+        # The soak's live plane (ISSUE 7): the chaos pipelines' drivers
+        # report into the process-default registry, so /metrics shows the
+        # driver layer moving mid-soak (polls/commits/restores/retries;
+        # the harness arms its injector on a private registry, so
+        # injected-fault totals stay out of this exposition); /healthz
+        # reports soak progress + fault-arm state; /tracez carries the
+        # soak's own per-seed spans (the harness-internal drivers keep
+        # private tracers, so their restore/commit spans live in their
+        # rings, not this server's).
+        from ..obs import IntrospectionServer, SpanTracer, default_registry
+
+        def _soak_health():
+            return dict(progress, total_seeds=args.seeds,
+                        runtime=args.runtime)
+
+        tracer = SpanTracer(default_registry())
+        server = IntrospectionServer(
+            registry=default_registry(), tracer=tracer,
+            health_fn=_soak_health, port=args.http_port,
+        ).start()
+        print(f"introspection plane: {server.url}")
+    import contextlib
+
     for seed in range(args.seeds_from, args.seeds_from + args.seeds):
         stream = _stream(seed, n=args.events)
         golden = _golden(stream, keys=keys, runtime=args.runtime, **opts)
@@ -70,9 +103,15 @@ def main(argv=None) -> int:
 
                 return pathlib.Path(tempfile.mkdtemp()) / name
 
-        chaos, crashes = _chaos(
-            _Tmp(), schedule, stream, keys=keys, runtime=args.runtime, **opts
+        span = (
+            tracer.span(f"seed-{seed}")
+            if tracer is not None else contextlib.nullcontext()
         )
+        with span:
+            chaos, crashes = _chaos(
+                _Tmp(), schedule, stream, keys=keys,
+                runtime=args.runtime, **opts
+            )
         ok = sorted(chaos) == sorted(golden)
         print(
             f"seed {seed}: {len(golden)} matches, {crashes} crashes, "
@@ -81,7 +120,11 @@ def main(argv=None) -> int:
         if not ok:
             failures += 1
             print(f"  schedule: {schedule}")
+        progress.update(seed=seed, done=progress["done"] + 1,
+                        failures=failures)
     print(f"{args.seeds} seeds, {failures} divergent")
+    if server is not None:
+        server.stop()
     return 1 if failures else 0
 
 

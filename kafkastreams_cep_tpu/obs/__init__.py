@@ -7,6 +7,8 @@ them as Prometheus 0.0.4 text (`to_prom_text`) or a JSON snapshot
 scripts/check_bench_schema.py validates. See PERF.md "v10" for the full
 metrics dictionary.
 """
+from .http import IntrospectionServer
+from .merge import merge_registries, merge_snapshots
 from .registry import (
     FAULT_SERIES,
     Counter,
@@ -25,10 +27,13 @@ __all__ = [
     "FAULT_SERIES",
     "Gauge",
     "Histogram",
+    "IntrospectionServer",
     "MetricsRegistry",
     "SpanTracer",
     "default_registry",
     "fault_series_totals",
+    "merge_registries",
+    "merge_snapshots",
     "parse_prom_text",
     "registry_from_snapshot",
 ]

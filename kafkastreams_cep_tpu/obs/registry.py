@@ -514,11 +514,19 @@ def next_instance_id() -> str:
 
 
 # --------------------------------------------------------------- round-trip
-def registry_from_snapshot(snap: Mapping[str, Any]) -> MetricsRegistry:
+def registry_from_snapshot(
+    snap: Mapping[str, Any], max_label_sets: Optional[int] = None
+) -> MetricsRegistry:
     """Rebuild a registry holding exactly a snapshot's values (histograms
     restore buckets/sum/count; the sample reservoir is not serialized, so
-    percentiles are unavailable on the rebuilt copy -- exposition only)."""
-    reg = MetricsRegistry()
+    percentiles are unavailable on the rebuilt copy -- exposition only).
+    `max_label_sets` overrides the rebuilt registry's cardinality bound
+    (obs/merge.py uses it so a fleet-wide merge stays bounded too)."""
+    reg = (
+        MetricsRegistry()
+        if max_label_sets is None
+        else MetricsRegistry(max_label_sets=max_label_sets)
+    )
     for name, fam in snap.items():
         kind = fam["type"]
         label_names = tuple(fam.get("label_names", ()))

@@ -7,12 +7,20 @@ same spine as the engine's section walls. `SpanTracer.device(log_dir)`
 wraps ops.profiling.device_trace (jax.profiler xplane capture) and records
 the capture wall as a span of the same name -- one call site for "time
 this, and profile the device while at it".
+
+Since ISSUE 7 the tracer also keeps a bounded ring of recent completed
+spans (`recent()`), which the introspection plane serves as `/tracez` --
+a curl-able "what did this process just spend time on" without a
+profiler attach.
 """
 from __future__ import annotations
 
 import contextlib
+import itertools
+import threading
 import time
-from typing import Any, Iterator, Optional
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
 
 from .registry import MetricsRegistry, default_registry
 
@@ -20,9 +28,15 @@ __all__ = ["SpanTracer"]
 
 
 class SpanTracer:
-    """Named wall-clock spans recorded into a MetricsRegistry."""
+    """Named wall-clock spans recorded into a MetricsRegistry.
 
-    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+    `ring` bounds the recent-span buffer behind `recent()` (the /tracez
+    surface); completed spans beyond it age out oldest-first.
+    """
+
+    def __init__(
+        self, registry: Optional[MetricsRegistry] = None, ring: int = 256
+    ) -> None:
         self.registry = registry if registry is not None else default_registry()
         self._hist = self.registry.histogram(
             "cep_span_seconds", "Host wall per named span", labels=("span",)
@@ -30,6 +44,10 @@ class SpanTracer:
         self._count = self.registry.counter(
             "cep_span_total", "Completed spans", labels=("span",)
         )
+        # deque appends are atomic, but recent()'s snapshot iteration must
+        # not race a rotating append from another thread.
+        self._ring: deque = deque(maxlen=max(1, ring))
+        self._ring_lock = threading.Lock()
 
     @contextlib.contextmanager
     def span(self, name: str) -> Iterator[None]:
@@ -37,8 +55,28 @@ class SpanTracer:
         try:
             yield
         finally:
-            self._hist.labels(span=name).observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._hist.labels(span=name).observe(dt)
             self._count.labels(span=name).inc()
+            with self._ring_lock:
+                self._ring.append(
+                    {
+                        "span": name,
+                        "end_unix": time.time(),
+                        "duration_s": dt,
+                    }
+                )
+
+    def recent(
+        self, limit: int = 64, name: Optional[str] = None
+    ) -> List[Dict[str, Any]]:
+        """Most-recent completed spans, newest first (the /tracez view)."""
+        with self._ring_lock:
+            spans = list(self._ring)
+        it = reversed(spans)
+        if name is not None:
+            it = (s for s in it if s["span"] == name)
+        return list(itertools.islice(it, max(0, limit)))
 
     @contextlib.contextmanager
     def device(self, log_dir: str, name: str = "device_trace") -> Iterator[Any]:

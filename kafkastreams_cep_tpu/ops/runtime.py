@@ -25,7 +25,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..core.event import Event
-from ..core.sequence import Sequence, SequenceBuilder, Staged
+from ..core.sequence import MatchProvenance, Sequence, SequenceBuilder, Staged
 from ..faults import injection as _flt
 from ..faults.injection import CEPOverflowError, TransientFault, with_retry
 from ..pattern.stages import Stages
@@ -568,6 +568,35 @@ def decode_chains(
                 continue
             chains[m].append((nm, g))
     return chains
+
+
+def sequence_provenance(
+    seq: Sequence, query: str = "q", trigger: str = "drain"
+) -> MatchProvenance:
+    """Derive one match's lineage from its materialized Sequence.
+
+    The Sequence IS the pulled chain table made host-real (stage groups in
+    traversal order, events oldest-first within a group), so every field
+    here is a pure host-side read -- no device pull, no extra sync:
+    stage path and Dewey-style version-path depth from the group walk
+    (DeweyVersion.add_stage appends one digit per stage entered), chain
+    depth from the hop count, and the window span from the first/last
+    events' source-log coordinates. Event order within the walk is the
+    Event contract's ((topic, partition, offset) / timestamp fallback)."""
+    events = [e for staged in seq.matched for e in staged.events]
+    first = min(events) if events else None
+    last = max(events) if events else None
+    return MatchProvenance(
+        query=query,
+        trigger=trigger,
+        stage_path=tuple(s.stage for s in seq.matched),
+        chain_depth=len(events),
+        branch_depth=len(seq.matched),
+        first_offset=first.offset if first is not None else -1,
+        last_offset=last.offset if last is not None else -1,
+        first_timestamp=first.timestamp if first is not None else -1,
+        last_timestamp=last.timestamp if last is not None else -1,
+    )
 
 
 def materialize_sequence(

@@ -99,6 +99,9 @@ class BatchedDeviceNFA:
         target_emit_ms: Optional[float] = None,
         profile_sync: bool = False,
         registry: Optional[Any] = None,
+        provenance_sample: float = 0.0,
+        provenance_ring: int = 256,
+        query_name: Optional[str] = None,
     ) -> None:
         if drain_mode not in ("flat", "pool"):
             raise ValueError(f"unknown drain_mode {drain_mode!r}")
@@ -288,6 +291,27 @@ class BatchedDeviceNFA:
         #: consumer: replacing it resets the percentile window, the spine's
         #: counters stay monotonic.
         self.timings = BatchTimings(registry=self.metrics)
+        #: Match-provenance exemplars (ISSUE 7): for a sampled fraction of
+        #: decoded matches the decode worker derives a lineage struct from
+        #: the already-materialized Sequence (the pulled chain table made
+        #: host-real -- zero extra device cost) and keeps it in a bounded
+        #: ring for /tracez?kind=match. Deterministic stride sampling:
+        #: `provenance_sample` accumulates per match and attaches on each
+        #: integer crossing, so rate r samples every 1/r-th match exactly.
+        if not 0.0 <= float(provenance_sample) <= 1.0:
+            raise ValueError(
+                f"provenance_sample must be in [0, 1], got {provenance_sample}"
+            )
+        self.provenance_sample = float(provenance_sample)
+        self.query_name = query_name
+        self._prov_acc = 0.0
+        self._prov_ring: deque = deque(maxlen=max(1, int(provenance_ring)))
+        # Writers (decode worker) and readers (HTTP scrape threads) race
+        # on the ring; the lock keeps the reader's snapshot iteration
+        # safe against a rotating append (same pattern as SpanTracer).
+        import threading as _threading
+
+        self._prov_lock = _threading.Lock()
         self._init_metrics()
 
     def _init_metrics(self) -> None:
@@ -381,6 +405,11 @@ class BatchedDeviceNFA:
             "(silent capacity loss made loud; see EngineConfig.on_overflow)",
             labels=("counter",),
         )
+        self._m_prov = r.counter(
+            "cep_provenance_sampled_total",
+            "Decoded matches that received a sampled lineage exemplar",
+            labels=("query",),
+        ).labels(query=self.query_name or "q")
 
     def _pick_engine(self, engine: str) -> Tuple[str, Optional[str]]:
         """Resolve "auto" to the fused pallas kernel when it applies.
@@ -491,9 +520,9 @@ class BatchedDeviceNFA:
         reduction + one host pull, like `stats` but resolved per mesh
         shard (contiguous key blocks; shard 0 is the whole engine on an
         unsharded key axis). An explicit sync; the registry's
-        `cep_shard_state_counter{counter, shard}` gauges piggyback on it.
-        Cross-mesh merging of per-device registries is deferred (see
-        ROADMAP "Open items")."""
+        `cep_shard_state_counter{counter, shard}` gauges piggyback on it,
+        and `device_registries()` + obs/merge.py turn the same pull into
+        one merged cross-device exposition (ISSUE 7)."""
         from .key_shard import shard_stats
 
         n_shards = 1
@@ -519,6 +548,36 @@ class BatchedDeviceNFA:
                     instance=self.instance_id, counter=name, shard=str(s)
                 ).set(int(arr[s]))
         return {k: np.asarray(v) for k, v in pulled.items()}
+
+    def device_registries(self) -> "Dict[str, Any]":
+        """Per-device MetricsRegistry view of the engine (ISSUE 7): one
+        registry per mesh shard, holding that shard's monotonic state
+        counters (`cep_device_state_total{counter}`) and its point-in-time
+        `cep_device_runs` gauge -- exactly the shapes obs/merge.py merges
+        (counters sum to the global totals, gauges pick up a `device`
+        label). One `shard_stats` pull feeds every registry; no extra
+        sync. Device ids are the mesh shard indices ("0".."n-1")."""
+        from ..obs.registry import MetricsRegistry
+
+        pulled = self.shard_stats()
+        n_shards = next(iter(pulled.values())).shape[0]
+        out: Dict[str, MetricsRegistry] = {}
+        for s in range(n_shards):
+            reg = MetricsRegistry()
+            counters = reg.counter(
+                "cep_device_state_total",
+                "Engine state counter totals on one device",
+                labels=("counter",),
+            )
+            for name, arr in pulled.items():
+                if name == "runs":
+                    reg.gauge(
+                        "cep_device_runs", "Live runs resident on one device"
+                    ).set(int(arr[s]))
+                else:
+                    counters.labels(counter=name).inc(int(arr[s]))
+            out[str(s)] = reg
+        return out
 
     def runs(self, key: Any) -> int:
         return int(np.asarray(self.state["runs"])[self.key_index[key]])
@@ -714,10 +773,9 @@ class BatchedDeviceNFA:
                 # their own drain only runs after the advance appended to
                 # the ring.
                 ring_full = occ + step_cap > self.config.matches
-                self._m_auto_drains.labels(
-                    trigger="ring_full" if ring_full else "region_pressure"
-                ).inc()
-                raw = self._pull_raw()
+                auto_trigger = "ring_full" if ring_full else "region_pressure"
+                self._m_auto_drains.labels(trigger=auto_trigger).inc()
+                raw = self._pull_raw(trigger=auto_trigger)
                 if raw is not None:
                     self._submit_decode(raw)
                 elif region_pressure and not ring_full:
@@ -885,7 +943,7 @@ class BatchedDeviceNFA:
             _, _, probed_pos = self._occupancy_bound()
             if probed_pos is None or probed_pos > 0:
                 self._m_auto_drains.labels(trigger="micro_drain").inc()
-                raw = self._pull_raw()
+                raw = self._pull_raw(trigger="micro_drain")
                 if raw is not None:
                     self._submit_decode(raw)
         out: Dict[Any, List[Sequence]] = {}
@@ -1050,7 +1108,7 @@ class BatchedDeviceNFA:
                 # the next drain boundary (_check_drop_counters).
                 return
             self._m_backpressure.inc()
-            raw = self._pull_raw()
+            raw = self._pull_raw(trigger="backpressure")
             if raw is not None:
                 self._submit_decode(raw)
             self._flush_group()
@@ -1494,13 +1552,16 @@ class BatchedDeviceNFA:
             self._drain_compact_fn = drain_compact
         return self._drain_compact_fn
 
-    def _pull_raw(self) -> Optional[Dict[str, Any]]:
+    def _pull_raw(self, trigger: str = "drain") -> Optional[Dict[str, Any]]:
         """Pull pending matches off the device and clear the ring (a sync
         point -- the probe; the bulk transfer is asynchronous on the flat
         path). Decode happens separately (`_decode_raw`, normally on the
         worker thread via `_submit_decode`) so the D2H wait and the Python
         materialization overlap the next dispatched batch. Returns None
-        when nothing is pending.
+        when nothing is pending. `trigger` records WHICH dial pulled the
+        ring (drain | ring_full | region_pressure | micro_drain |
+        backpressure) -- it rides the raw snapshot into the decode worker
+        so sampled provenance exemplars name their emitting drain.
 
         Mid-group, pending matches may reference window node ids the
         region planes don't cover. The flat path drains from a VIRTUAL
@@ -1515,11 +1576,16 @@ class BatchedDeviceNFA:
 
         self._last_pull_t = _time.perf_counter()
         if self.drain_mode == "flat" and not self.exact_replay:
-            return self._pull_raw_flat(self._window_pool_view())
-        self._flush_group()
-        if self.drain_mode == "flat":
-            return self._pull_raw_flat(self.pool)
-        return self._pull_raw_pool()
+            raw = self._pull_raw_flat(self._window_pool_view())
+        else:
+            self._flush_group()
+            if self.drain_mode == "flat":
+                raw = self._pull_raw_flat(self.pool)
+            else:
+                raw = self._pull_raw_pool()
+        if raw is not None:
+            raw["trigger"] = trigger
+        return raw
 
     def _window_pool_view(self) -> Dict[str, jnp.ndarray]:
         """The drain-time virtual pool: node planes with the group's
@@ -1731,11 +1797,65 @@ class BatchedDeviceNFA:
 
         t0 = _time.perf_counter()
         decoded = self._decode_raw(raw, events=events)
+        # Provenance sampling rides the decode worker (the Sequences are
+        # right here, host-side); the advance path never sees it.
+        self._attach_provenance(decoded, raw.get("trigger", "drain"))
         # The flat path records its own decode_s (net of the D2H wait it
         # performs in-job); the pool path's pull happened on the calling
         # thread, so its whole job time is decode.
         raw.setdefault("decode_s", _time.perf_counter() - t0)
         return decoded, raw
+
+    def _attach_provenance(
+        self, decoded: Dict[Any, List[Any]], trigger: str
+    ) -> None:
+        """Attach sampled MatchProvenance to decoded Sequences and record
+        the exemplars in the bounded ring (/tracez?kind=match).
+
+        Runs on the single decode worker (or the caller's thread when a
+        drain decodes inline), so the stride accumulator needs no lock;
+        the ring is a deque (atomic appends) snapshotted by readers."""
+        if self.provenance_sample <= 0.0 or not decoded:
+            return
+        from ..ops.runtime import sequence_provenance
+
+        names = self.query.query_names
+        for key, seqs in decoded.items():
+            for item in seqs:
+                self._prov_acc += self.provenance_sample
+                if self._prov_acc < 1.0:
+                    continue
+                self._prov_acc -= 1.0
+                if isinstance(item, tuple):
+                    qid, seq = item  # stacked-query attribution
+                    qname = (
+                        names[qid]
+                        if names is not None and 0 <= qid < len(names)
+                        else f"q{qid}"
+                    )
+                else:
+                    seq = item
+                    qname = self.query_name or "q"
+                prov = sequence_provenance(seq, query=qname, trigger=trigger)
+                seq.provenance = prov
+                # The raw key object rides the ring (a lane handle on the
+                # streams path); readers stringify, and the engine's
+                # exemplar reader unwraps lanes to user keys.
+                with self._prov_lock:
+                    self._prov_ring.append((key, prov))
+                self._m_prov.inc()
+
+    def provenance_exemplars(self, limit: int = 64) -> List[Dict[str, Any]]:
+        """Recent sampled match-lineage exemplars as JSON-ready dicts,
+        newest first (the /tracez?kind=match surface)."""
+        with self._prov_lock:
+            snap = list(self._prov_ring)
+        out: List[Dict[str, Any]] = []
+        for key, prov in snap[::-1][: max(0, limit)]:
+            entry = prov.to_dict()
+            entry["key"] = str(getattr(key, "key", key))
+            out.append(entry)
+        return out
 
     def _decode_raw(
         self,

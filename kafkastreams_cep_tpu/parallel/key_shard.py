@@ -215,7 +215,9 @@ def shard_stats(
     per-shard gauges (BatchedDeviceNFA.shard_stats): under a sharded key
     axis each block sum stays device-local and only the tiny [n_shards]
     result crosses ICI at the pull -- the per-event hot path still carries
-    no collectives (SURVEY.md section 2.8/5.5)."""
+    no collectives (SURVEY.md section 2.8/5.5). The same pull feeds
+    BatchedDeviceNFA.device_registries(), whose per-shard registries
+    obs/merge.py combines into one cross-device exposition (ISSUE 7)."""
     keys = STATE_COUNTER_KEYS + ("runs",)
 
     def per_shard(leaf: jnp.ndarray) -> jnp.ndarray:

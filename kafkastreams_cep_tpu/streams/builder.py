@@ -99,6 +99,22 @@ class QueryNode(Generic[K, V]):
         self.gate = EmissionGate(
             self.name, store=self.emission_store, registry=registry
         )
+        # End-to-end match latency (ISSUE 7): ingest wall stamp (driver
+        # poll, Topology.stamp_ingest) -> sink emission, observed at the
+        # emission point for BOTH runtimes. Host-side only: the stamp map
+        # and the observe ride the existing emission path, never the
+        # device.
+        from ..obs.registry import default_registry
+        from ..ops.profiling import LATENCY_BUCKETS
+
+        self._m_match_latency = (
+            registry if registry is not None else default_registry()
+        ).histogram(
+            "cep_match_latency_seconds",
+            "Ingest (driver poll stamp) -> sink emission wall per match",
+            labels=("query",),
+            buckets=LATENCY_BUCKETS,
+        ).labels(query=self.name)
         if runtime == "tpu":
             from .device_processor import DeviceCEPProcessor, DeviceStateStore
 
@@ -216,10 +232,47 @@ class ComplexStreamsBuilder:
 class Topology:
     """The built processing graph, drivable record-by-record."""
 
+    #: Ingest-stamp map bound: records that never complete a match would
+    #: otherwise pin their stamp forever; past the bound the oldest stamps
+    #: evict (their eventual matches simply skip the latency observation).
+    INGEST_STAMPS_MAX = 1 << 16
+
     def __init__(self, queries: List[tuple], log: Optional[Any] = None) -> None:
         self.queries = queries
         self.log = log
         self._offsets: Dict[tuple, int] = {}
+        # (topic, partition, key, offset) -> ingest wall stamp
+        # (time.perf_counter), written by the driver at poll time, read at
+        # sink emission for the cep_match_latency_seconds{query} histogram.
+        # The full event-identity key: (key, offset) alone collides across
+        # topics/partitions and would skew samples. A plain dict keeps
+        # insertion order, so eviction below drops the oldest stamps.
+        self._ingest_stamps: Dict[tuple, float] = {}
+
+    def stamp_ingest(
+        self, topic: str, partition: int, key, offset: int, t: float
+    ) -> None:
+        """Record one record's ingest wall time (driver poll path)."""
+        stamps = self._ingest_stamps
+        stamps[(topic, partition, key, offset)] = t
+        # O(1) oldest-first eviction (dict preserves insertion order);
+        # this runs per record on the poll path, so no list materializing.
+        while len(stamps) > self.INGEST_STAMPS_MAX:
+            del stamps[next(iter(stamps))]
+
+    def _observe_match_latency(
+        self, node: QueryNode, topic: str, partition: int, key, offset: int
+    ) -> None:
+        """Observe ingest -> emission latency for one emitted match, keyed
+        by its completing event's identity. The stamp stays: several
+        matches may complete on one event, and replay dedup upstream
+        already bounds re-observation."""
+        t0 = self._ingest_stamps.get((topic, partition, key, offset))
+        if t0 is None:
+            return  # direct process() calls / evicted stamp: no sample
+        import time as _time
+
+        node._m_match_latency.observe(_time.perf_counter() - t0)
 
     @property
     def source_topics(self) -> List[str]:
@@ -268,6 +321,9 @@ class Topology:
                     for fn in node.downstream:
                         fn(key, seq)
                     if digest is not None:
+                        self._observe_match_latency(
+                            node, topic, partition, key, offset
+                        )
                         self._sink(node, record, digest)
         return outputs
 
@@ -306,6 +362,13 @@ class Topology:
             for fn in node.downstream:
                 fn(rkey, seq)
             if digest is not None:
+                if last is not None:
+                    # Device matches complete at their last event: the
+                    # ingest stamp of that event's identity anchors the
+                    # end-to-end latency sample.
+                    self._observe_match_latency(
+                        node, last.topic, last.partition, rkey, last.offset
+                    )
                 self._sink(node, record, digest)
         return emitted
 

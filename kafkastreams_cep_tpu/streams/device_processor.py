@@ -62,8 +62,11 @@ class DeviceCEPProcessor(Generic[K, V]):
         self.config = config if config is not None else EngineConfig()
         self.batch_size = max(1, batch_size)
         self._capacity = max(1, initial_keys)
-        #: Extra BatchedDeviceNFA knobs (engine=, drain_mode=, ...) --
-        #: retained so checkpoint restore rebuilds the same engine shape.
+        #: Extra BatchedDeviceNFA knobs (engine=, drain_mode=,
+        #: provenance_sample=, ...) -- retained so checkpoint restore
+        #: rebuilds the same engine shape. Provenance exemplars label
+        #: their owning query, so the query name rides into the engine.
+        engine_opts.setdefault("query_name", self.query_name)
         self._engine_opts = dict(engine_opts)
         # `registry` flows into the engine, so the device driver and its
         # engine share one spine; per-query stream counters ride the same
@@ -204,6 +207,13 @@ class DeviceCEPProcessor(Generic[K, V]):
         out, self._poisoned = self._poisoned, []
         return out
 
+    def provenance_exemplars(self, limit: int = 64) -> List[Dict[str, Any]]:
+        """Recent sampled match-lineage exemplars from the engine (the
+        /tracez?kind=match surface; empty unless provenance_sample > 0).
+        Lane handles are internal; the engine's exemplar reader already
+        unwraps them to the user-visible record keys (getattr .key)."""
+        return self.engine.provenance_exemplars(limit)
+
     def runs(self, key: K) -> int:
         return self.engine.runs(self._lane_for(key))
 
@@ -260,7 +270,10 @@ class DeviceCEPProcessor(Generic[K, V]):
         read_magic(r)
         proc.engine = BatchedDeviceNFA.restore(
             proc.query, r.blob(), config=proc.config, mesh=mesh,
-            registry=registry, **engine_opts,
+            # _engine_opts, not the raw kwargs: the ctor defaulted the
+            # query name in, so the restored engine labels provenance
+            # identically to the original.
+            registry=registry, **proc._engine_opts,
         )
         proc._capacity = len(proc.engine.keys)
         proc._lane_of_key = {

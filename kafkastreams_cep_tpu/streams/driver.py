@@ -20,6 +20,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from ..faults import injection as _flt
 from ..faults.injection import CEPOverflowError, TransientFault, with_retry
 from ..obs.registry import MetricsRegistry, default_registry
+from ..obs.trace import SpanTracer
 from ..state.store import default_deserializer, default_serializer
 from .builder import Topology
 from .log import RecordLog
@@ -59,10 +60,15 @@ class LogDriver:
     The Kafka-Streams-metrics surface the reference delegates to the
     framework lives here too: poll/record/commit counters and the restore
     wall land in `registry` (the process default when none is passed).
-    `report_every_s` arms a periodic reporter: after a poll, once the
-    interval has elapsed since the last report, `reporter` is called with
-    the registry's prom-text exposition (default: the
-    `kafkastreams_cep_tpu.obs` logger at INFO)."""
+    `report_every_s` arms a periodic reporter: once the interval has
+    elapsed since the last report, `reporter` is called with the
+    registry's prom-text exposition (default: the
+    `kafkastreams_cep_tpu.obs` logger at INFO). The cadence check runs
+    after each poll and -- when `serve_http()` attached the introspection
+    plane -- from its clock thread, so idle topics report on time too
+    (ISSUE 7; the poll-gated cadence alone never reported on an idle
+    topic). `serve_http()` additionally exposes /metrics, /snapshot,
+    /healthz and /tracez over stdlib HTTP."""
 
     def __init__(
         self,
@@ -134,6 +140,21 @@ class LogDriver:
         self.report_every_s = report_every_s
         self.reporter = reporter
         self._last_report_t = time.perf_counter()
+        # maybe_report may now be driven from the HTTP plane's clock
+        # thread AND the poll path; the lock keeps a report atomic and the
+        # cadence check race-free (ISSUE 7 idle-reporter fix).
+        import threading
+
+        self._report_lock = threading.Lock()
+        #: Host span tracer (restore/poll/commit land in /tracez and the
+        #: cep_span_seconds histogram of this driver's registry).
+        self.tracer = SpanTracer(self.metrics)
+        #: Liveness wall clocks for /healthz (None until the first event).
+        self._t_started = time.time()
+        self._last_poll_wall: Optional[float] = None
+        self._last_commit_wall: Optional[float] = None
+        #: The attached introspection server, if serve_http() was called.
+        self.http = None
         self._positions: Dict[Tuple[str, int], int] = {}
         #: positions as last durably committed -- commit() appends only the
         #: deltas, so the offsets topic grows with progress, not with the
@@ -152,13 +173,14 @@ class LogDriver:
             # hard cap: a wedged changelog surfaces as a counted failure
             # plus the final exception, never a silent hang or hot loop.
             try:
-                self.restored_records = with_retry(
-                    _restore,
-                    site="driver.restore",
-                    attempts=self.max_restore_attempts,
-                    retry_on=(Exception,),
-                    registry=self.metrics,
-                )
+                with self.tracer.span("restore"):
+                    self.restored_records = with_retry(
+                        _restore,
+                        site="driver.restore",
+                        attempts=self.max_restore_attempts,
+                        retry_on=(Exception,),
+                        registry=self.metrics,
+                    )
             except Exception:
                 self._m_restore_failures.inc()
                 raise
@@ -188,24 +210,27 @@ class LogDriver:
         fsynced BEFORE the offset record is appended and fsynced, so a crash
         between the two replays the interval (deduped by the HWM) instead of
         silently skipping records whose effects were lost."""
-        self.topology.flush_stores()
-        self.log.flush()  # changelog + sink records durable first
-        dirty = {
-            tp: pos
-            for tp, pos in self._positions.items()
-            if self._committed.get(tp) != pos
-        }
-        if not dirty:
-            return
-        for (topic, partition), pos in dirty.items():
-            self.log.append(
-                OFFSETS_TOPIC,
-                default_serializer((self.group, topic, partition)),
-                default_serializer(pos),
-            )
-        self.log.flush()
-        self._committed.update(dirty)
-        self._m_commits.inc()
+        with self.tracer.span("commit"):
+            self.topology.flush_stores()
+            self.log.flush()  # changelog + sink records durable first
+            dirty = {
+                tp: pos
+                for tp, pos in self._positions.items()
+                if self._committed.get(tp) != pos
+            }
+            if not dirty:
+                self._last_commit_wall = time.time()
+                return
+            for (topic, partition), pos in dirty.items():
+                self.log.append(
+                    OFFSETS_TOPIC,
+                    default_serializer((self.group, topic, partition)),
+                    default_serializer(pos),
+                )
+            self.log.flush()
+            self._committed.update(dirty)
+            self._m_commits.inc()
+            self._last_commit_wall = time.time()
 
     def position(self, topic: str, partition: int = 0) -> int:
         return self._positions.get((topic, partition), 0)
@@ -243,6 +268,13 @@ class LogDriver:
                         )
                         processed += 1
                         continue
+                    # Ingest wall stamp (ISSUE 7): keyed by the record's
+                    # full event identity, read back at sink emission to
+                    # observe cep_match_latency_seconds{query}.
+                    self.topology.stamp_ingest(
+                        topic, partition, key, rec.offset,
+                        time.perf_counter(),
+                    )
                     try:
                         self.topology.process(
                             topic,
@@ -283,7 +315,8 @@ class LogDriver:
                 _flt.ACTIVE.fire("driver.post_commit")
         self._m_polls.inc()
         self._m_records.inc(processed)
-        self._maybe_report()
+        self._last_poll_wall = time.time()
+        self.maybe_report()
         return processed
 
     # -------------------------------------------------------------- poison
@@ -332,31 +365,130 @@ class LogDriver:
             )
 
     # ---------------------------------------------------------- reporting
-    def _maybe_report(self) -> None:
+    def maybe_report(self) -> bool:
         """Periodic reporter hook: emit the registry's prom-text exposition
-        once `report_every_s` has elapsed since the last report (checked
-        after each poll -- the driver's natural cadence point)."""
-        if self.report_every_s is None:
-            return
-        now = time.perf_counter()
-        if now - self._last_report_t < self.report_every_s:
-            return
-        self._last_report_t = now
-        import logging
+        once `report_every_s` has elapsed since the last report.
 
-        # Best-effort: a failing reporter (push gateway blip) must never
-        # break the data path -- records were already processed and
-        # offsets committed by the time we get here.
-        try:
-            text = self.metrics.to_prom_text()
-            if self.reporter is not None:
-                self.reporter(text)
-            else:
-                logging.getLogger("kafkastreams_cep_tpu.obs").info(
-                    "metrics report (group=%s)\n%s", self.group, text
+        Called after each poll AND from the introspection plane's clock
+        thread (`serve_http`), so an idle topic still reports on time --
+        the poll-gated cadence was the ISSUE 7 regression (no poll, no
+        report). Thread-safe: one report per elapsed interval, whichever
+        caller gets there first. Returns True when a report fired."""
+        if self.report_every_s is None:
+            return False
+        with self._report_lock:
+            # Re-check under the lock: a caller that disarms the reporter
+            # (report_every_s = None) and then holds this lock once is
+            # guaranteed no report lands afterwards -- bench.py's
+            # served-text-vs-snapshot equality relies on that barrier.
+            if self.report_every_s is None:
+                return False
+            now = time.perf_counter()
+            if now - self._last_report_t < self.report_every_s:
+                return False
+            self._last_report_t = now
+            import logging
+
+            # Best-effort: a failing reporter (push gateway blip) must
+            # never break the data path -- records were already processed
+            # and offsets committed by the time we get here.
+            try:
+                text = self.metrics.to_prom_text()
+                if self.reporter is not None:
+                    self.reporter(text)
+                else:
+                    logging.getLogger("kafkastreams_cep_tpu.obs").info(
+                        "metrics report (group=%s)\n%s", self.group, text
+                    )
+                self._m_reports.inc()
+                return True
+            except Exception:
+                logging.getLogger("kafkastreams_cep_tpu.obs").warning(
+                    "metrics reporter failed (group=%s)",
+                    self.group, exc_info=True,
                 )
-            self._m_reports.inc()
-        except Exception:
-            logging.getLogger("kafkastreams_cep_tpu.obs").warning(
-                "metrics reporter failed (group=%s)", self.group, exc_info=True
-            )
+                return False
+
+    # ------------------------------------------------------- introspection
+    def health(self) -> Dict[str, Any]:
+        """Liveness view for /healthz: poll/commit recency, restore state,
+        fault-arm state. Pure host-side reads -- safe from any thread."""
+        now = time.time()
+        return {
+            "group": self.group,
+            "uptime_s": now - self._t_started,
+            "polls": self._m_polls.value,
+            "records": self._m_records.value,
+            "commits": self._m_commits.value,
+            "last_poll_age_s": (
+                now - self._last_poll_wall
+                if self._last_poll_wall is not None else None
+            ),
+            "last_commit_age_s": (
+                now - self._last_commit_wall
+                if self._last_commit_wall is not None else None
+            ),
+            "restored_records": self.restored_records,
+            "restore_failures": self._m_restore_failures.value,
+            "dead_letters": sum(
+                child.value
+                for _lv, child in self._m_dead_letters._sorted_children()
+            ),
+            "faults_armed": _flt.ACTIVE is not None,
+            "report_every_s": self.report_every_s,
+        }
+
+    def disarm_reporter(self) -> None:
+        """Disarm the periodic reporter AND quiesce any in-flight report.
+
+        Setting `report_every_s = None` alone leaves a race: a clock tick
+        already past maybe_report's fast-path check can still emit. The
+        lock round-trip here is the barrier -- maybe_report re-checks the
+        disarm under the same lock, so after this returns no report can
+        move a counter (bench.py's served-text-vs-snapshot equality
+        depends on it)."""
+        self.report_every_s = None
+        with self._report_lock:
+            pass
+
+    def match_exemplars(self, limit: int = 64) -> list:
+        """Sampled match-provenance exemplars across every device-runtime
+        query in the topology (newest-first per processor), the
+        /tracez?kind=match source."""
+        out: list = []
+        for _stream, node, _o in self.topology.queries:
+            fn = getattr(node.processor, "provenance_exemplars", None)
+            if fn is not None:
+                out.extend(fn(limit))
+        return out[:limit]
+
+    def serve_http(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tick_every_s: Optional[float] = None,
+    ):
+        """Attach the live introspection plane (obs/http.py) to this
+        driver: /metrics and /snapshot expose `self.metrics`, /healthz
+        reports `health()`, /tracez serves the driver's spans and the
+        topology's sampled match exemplars. The plane's clock thread
+        drives `maybe_report` on wall time, so `report_every_s` fires on
+        idle topics too. Returns the started IntrospectionServer (also
+        kept on `self.http`); `port=0` binds an ephemeral port."""
+        from ..obs.http import IntrospectionServer
+
+        if tick_every_s is None:
+            tick_every_s = 0.25
+            if self.report_every_s is not None:
+                tick_every_s = max(0.01, min(0.25, self.report_every_s / 2))
+        self.http = IntrospectionServer(
+            registry=self.metrics,
+            tracer=self.tracer,
+            health_fn=self.health,
+            match_exemplars=self.match_exemplars,
+            tick_fns=(self.maybe_report,),
+            tick_every_s=tick_every_s,
+            host=host,
+            port=port,
+        ).start()
+        return self.http

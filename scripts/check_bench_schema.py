@@ -43,7 +43,32 @@ TOP_LEVEL: Dict[str, Tuple[bool, tuple]] = {
     "configs": (True, (dict,)),
     "metrics": (True, (dict,)),
     "faults": (True, (dict,)),
+    "latency": (True, (dict, type(None))),
+    "observation": (True, (dict,)),
+    "metrics_merged": (True, (dict, type(None))),
     "schema_ok": (False, (bool,)),
+}
+
+#: The `observation` block (ISSUE 7): what telemetry was armed while the
+#: numbers were taken, so BENCH_r* artifacts self-describe the
+#: observation overhead. http_* keys are None outside --smoke.
+OBSERVATION_KEYS: Dict[str, tuple] = {
+    "provenance_sample": NUMBER,
+    "http_server": (bool,),
+    "http_endpoints_ok": (bool, type(None)),
+    "served_matches_snapshot": (bool, type(None)),
+}
+
+#: The `latency` block (ISSUE 7): the end-to-end match-latency histogram
+#: (ingest stamp at driver poll -> sink emission) from the smoke
+#: introspection pipeline. Percentiles are None until a match emitted.
+LATENCY_KEYS: Dict[str, tuple] = {
+    "query": (str,),
+    "count": NUMBER,
+    "sum_s": NUMBER,
+    "p50_ms": OPT_NUMBER,
+    "p99_ms": OPT_NUMBER,
+    "buckets": (dict,),
 }
 
 #: The `faults` block (ISSUE 6): label-summed totals of every fault/
@@ -89,14 +114,41 @@ def _check_components(c: Optional[dict], where: str, errors: List[str]) -> None:
             errors.append(f"{where}: undocumented component key {k!r}")
 
 
-def _check_metrics_section(snap: dict, errors: List[str]) -> None:
-    """Structural check of a registry snapshot + prom-text round-trip."""
+def _check_flat_block(
+    block: Optional[dict],
+    keys: Dict[str, tuple],
+    where: str,
+    errors: List[str],
+) -> None:
+    """Documented-key check for a flat dict block (observation, latency)."""
+    if block is None:
+        return
+    for k, types in keys.items():
+        if k not in block:
+            errors.append(f"{where}: missing documented key {k!r}")
+        elif not isinstance(block[k], types):
+            errors.append(
+                f"{where}.{k}: expected {types}, got {type(block[k]).__name__}"
+            )
+    for k in block:
+        if k not in keys:
+            errors.append(f"{where}: undocumented key {k!r}")
+
+
+def _check_metrics_section(
+    snap: dict, errors: List[str], section: str = "metrics"
+) -> None:
+    """Structural check of a registry snapshot + prom-text round-trip.
+
+    `section` names the artifact key being checked -- the same contract
+    applies to the primary `metrics` snapshot and the merged
+    cross-registry `metrics_merged` one (obs/merge.py output)."""
     # Section-local structural errors gate the round-trip below (a
     # malformed snapshot cannot be rebuilt); unrelated errors from other
     # sections must not suppress this check.
     local: List[str] = []
     for name, fam in snap.items():
-        where = f"metrics.{name}"
+        where = f"{section}.{name}"
         if not isinstance(fam, dict):
             local.append(f"{where}: expected object")
             continue
@@ -125,7 +177,7 @@ def _check_metrics_section(snap: dict, errors: List[str]) -> None:
             registry_from_snapshot,
         )
     except Exception as exc:  # pragma: no cover - missing package on PATH
-        errors.append(f"metrics: cannot import obs registry ({exc})")
+        errors.append(f"{section}: cannot import obs registry ({exc})")
         return
     reg = registry_from_snapshot(snap)
     parsed = parse_prom_text(reg.to_prom_text())
@@ -159,12 +211,12 @@ def _check_metrics_section(snap: dict, errors: List[str]) -> None:
                 got = parsed.get(sample, {}).get(labels)
                 if got is None:
                     errors.append(
-                        f"metrics round-trip: {sample}{dict(labels)} "
+                        f"{section} round-trip: {sample}{dict(labels)} "
                         "missing from prom text"
                     )
                 elif not close(got, want):
                     errors.append(
-                        f"metrics round-trip: {sample}{dict(labels)} "
+                        f"{section} round-trip: {sample}{dict(labels)} "
                         f"prom={got} snapshot={want}"
                     )
 
@@ -203,6 +255,16 @@ def validate(out: Any) -> List[str]:
                 )
     if isinstance(out.get("metrics"), dict):
         _check_metrics_section(out["metrics"], errors)
+    if isinstance(out.get("metrics_merged"), dict):
+        _check_metrics_section(
+            out["metrics_merged"], errors, section="metrics_merged"
+        )
+    if isinstance(out.get("observation"), dict):
+        _check_flat_block(
+            out["observation"], OBSERVATION_KEYS, "observation", errors
+        )
+    if isinstance(out.get("latency"), (dict, type(None))):
+        _check_flat_block(out.get("latency"), LATENCY_KEYS, "latency", errors)
     faults = out.get("faults")
     if isinstance(faults, dict):
         for k in FAULT_KEYS:

@@ -49,4 +49,10 @@ def pytest_configure(config):
         "markers",
         "chaos: seeded fault-injection tests (fast, deterministic, CPU-safe)",
     )
+    # `obs` mirrors `chaos`: rides tier-1, and `pytest -m obs` selects the
+    # observability suite (registry/exposition/introspection-plane tests).
+    config.addinivalue_line(
+        "markers",
+        "obs: observability-suite tests (fast, deterministic, CPU-safe)",
+    )
     config.addinivalue_line("markers", "slow: excluded from tier-1")

@@ -254,10 +254,10 @@ def test_dense_append_defers_host_drains_on_sparse_matches():
     pulls = 0
     orig_pull = bat._pull_raw
 
-    def counting_pull():
+    def counting_pull(**kw):
         nonlocal pulls
         pulls += 1
-        return orig_pull()
+        return orig_pull(**kw)
 
     bat._pull_raw = counting_pull
     for b in range(n_batches):

@@ -564,7 +564,8 @@ def test_disarmed_hooks_keep_advance_async(monkeypatch):
     real_pull = eng._pull_raw
     monkeypatch.setattr(
         eng, "_pull_raw",
-        lambda: calls.__setitem__("pull", calls["pull"] + 1) or real_pull(),
+        lambda **kw: calls.__setitem__("pull", calls["pull"] + 1)
+        or real_pull(**kw),
     )
     for b in range(4):
         xs = eng.pack({"x": [Event("x", "Z", 2000 + 10 * b + i, "t", 0,

@@ -272,9 +272,9 @@ def test_region_pressure_guard_gates_on_probed_cursor():
     pulls = []
     orig_pull = bat._pull_raw
 
-    def counting_pull():
+    def counting_pull(**kw):
         pulls.append(1)
-        return orig_pull()
+        return orig_pull(**kw)
 
     bat._pull_raw = counting_pull
     noise = {"k0": [Event("k0", "D", TS + i, "t", 0, i) for i in range(4)]}

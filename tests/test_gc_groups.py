@@ -243,7 +243,7 @@ def test_groups_mid_group_checkpoint_restore(G):
     window lives outside the serialized pool): restore + continue must
     equal the G=1 run, and the serialized gc_phase must be 0."""
     from kafkastreams_cep_tpu.state.serde import (
-        _Reader, decode_array_tree, read_magic,
+        _Reader, decode_array_tree, open_frame, read_magic,
     )
     import pickle
 
@@ -262,7 +262,9 @@ def test_groups_mid_group_checkpoint_restore(G):
                 decode=False,
             )
         blob = bat.snapshot()
-        r = _Reader(blob)
+        # Snapshots are CRC-sealed since the crash-consistency work:
+        # open the frame before reading the serde payload.
+        r = _Reader(open_frame(blob))
         read_magic(r)
         pickle.loads(r.blob())  # keys
         tree = decode_array_tree(r.blob())
@@ -419,9 +421,9 @@ def test_target_emit_ms_micro_drains():
         pulls = [0]
         orig = bat._pull_raw
 
-        def counting():
+        def counting(**kw):
             pulls[0] += 1
-            return orig()
+            return orig(**kw)
 
         bat._pull_raw = counting
         for b in range(9):
@@ -466,9 +468,9 @@ def test_target_emit_ms_gates_on_probed_cursor():
     pulls = [0]
     orig = bat._pull_raw
 
-    def counting():
+    def counting(**kw):
         pulls[0] += 1
-        return orig()
+        return orig(**kw)
 
     bat._pull_raw = counting
     for b in range(9):

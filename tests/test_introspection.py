@@ -1,0 +1,523 @@
+"""Live introspection plane (ISSUE 7): registry merge, HTTP exposition,
+time-driven reporting, end-to-end match latency, match provenance.
+
+Pins the tentpole contracts:
+- obs/merge.py semantics: counters sum, gauges pick up a `device` label,
+  histograms merge bucket-wise, and the MERGED registry round-trips
+  through both expositions (prom text <-> snapshot), including the
+  bounded-cardinality edge;
+- the HTTP plane serves /metrics, /snapshot, /healthz and /tracez from a
+  live LogDriver, and its clock thread drives the periodic reporter on
+  wall time (the poll-gated reporter never fired on an idle topic --
+  the ISSUE 7 regression test);
+- `cep_match_latency_seconds{query}`: ingest stamp at driver poll ->
+  sink emission, for both runtimes, with zero device involvement;
+- provenance exemplars: the sampled lineage agrees with the host-oracle
+  NFA run for the same stream on both step engines and both drain modes
+  (differential pin), and stride sampling is deterministic.
+"""
+from __future__ import annotations
+
+import json
+import random
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kafkastreams_cep_tpu import (
+    ComplexStreamsBuilder,
+    LogDriver,
+    QueryBuilder,
+    RecordLog,
+    compile_pattern,
+    produce,
+)
+from kafkastreams_cep_tpu.core.event import Event
+from kafkastreams_cep_tpu.nfa.nfa import NFA
+from kafkastreams_cep_tpu.obs import (
+    IntrospectionServer,
+    MetricsRegistry,
+    SpanTracer,
+    merge_registries,
+    merge_snapshots,
+    parse_prom_text,
+    registry_from_snapshot,
+)
+from kafkastreams_cep_tpu.ops.engine import EngineConfig
+from kafkastreams_cep_tpu.ops.runtime import sequence_provenance
+from kafkastreams_cep_tpu.ops.tables import compile_query
+from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA
+from kafkastreams_cep_tpu.pattern.expressions import value
+from kafkastreams_cep_tpu.state.aggregates import AggregatesStore
+from kafkastreams_cep_tpu.state.buffer import SharedVersionedBuffer
+
+pytestmark = pytest.mark.obs
+
+TS = 1_000_000
+
+
+def letters_pattern():
+    return (
+        QueryBuilder()
+        .select("a").where(value() == "A")
+        .then().select("b").where(value() == "B")
+        .then().select("c").where(value() == "C")
+        .build()
+    )
+
+
+def letter_stream(seed, n, key="K"):
+    rng = random.Random(seed)
+    return [
+        Event(key, rng.choice("ABCD"), TS + i, "t", 0, i) for i in range(n)
+    ]
+
+
+def _get(url: str):
+    return urllib.request.urlopen(url, timeout=10).read()
+
+
+def _get_json(url: str):
+    return json.loads(_get(url))
+
+
+# ------------------------------------------------------------------- merge
+def _device_regs(n=3):
+    regs = {}
+    for d in range(n):
+        r = MetricsRegistry()
+        r.counter("dev_events_total", "events", labels=("counter",)).labels(
+            counter="n_events"
+        ).inc(10 * (d + 1))
+        r.gauge("dev_fill", "region fill").set(d)
+        h = r.histogram("dev_wall_seconds", "wall", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5 * (d + 1))
+        regs[str(d)] = r
+    return regs
+
+
+def test_merge_counters_sum_gauges_device_label_histograms_bucketwise():
+    merged = merge_registries(_device_regs())
+    snap = merged.snapshot()
+    # Counters with identical label sets summed across devices.
+    assert snap["dev_events_total"]["values"][0]["value"] == 60
+    assert snap["dev_events_total"]["label_names"] == ["counter"]
+    # Gauges became per-device series under the appended `device` label.
+    assert snap["dev_fill"]["label_names"] == ["device"]
+    fills = {
+        v["labels"]["device"]: v["value"] for v in snap["dev_fill"]["values"]
+    }
+    assert fills == {"0": 0.0, "1": 1.0, "2": 2.0}
+    # Histograms merged bucket-wise: counts and sums add, layout kept.
+    hv = snap["dev_wall_seconds"]["values"][0]
+    assert hv["count"] == 6
+    assert abs(hv["sum"] - (3 * 0.05 + 0.5 + 1.0 + 1.5)) < 1e-9
+    assert hv["buckets"]["0.1"] == 3  # the three 0.05 observations
+    assert hv["buckets"]["+Inf"] == 6
+
+
+def test_merged_registry_round_trips_both_expositions():
+    """Satellite: parse_prom_text / registry_from_snapshot round-trip over
+    a MERGED multi-device registry (device= labels, summed counters,
+    bucket-merged histograms)."""
+    merged = merge_registries(_device_regs())
+    snap = merged.snapshot()
+    rebuilt = registry_from_snapshot(snap)
+    assert rebuilt.to_prom_text() == merged.to_prom_text()
+    parsed = parse_prom_text(merged.to_prom_text())
+    assert parsed["dev_events_total"][(("counter", "n_events"),)] == 60
+    assert parsed["dev_fill"][(("device", "2"),)] == 2
+    assert parsed["dev_wall_seconds_count"][()] == 6
+    assert parsed["dev_wall_seconds_bucket"][(("le", "0.1"),)] == 3
+    # Snapshot-level merge agrees with the live-registry merge.
+    snap2 = merge_snapshots(
+        {d: r.snapshot() for d, r in _device_regs().items()}
+    )
+    assert registry_from_snapshot(snap2).to_prom_text() == merged.to_prom_text()
+
+
+def test_merge_bounded_cardinality_and_mismatches():
+    # The merged registry still enforces the cardinality bound: K devices
+    # x 1 gauge series exceeds a bound of 2.
+    with pytest.raises(ValueError, match="cardinality"):
+        merge_registries(_device_regs(4), max_label_sets=2)
+    # Kind mismatch across devices is a bug, not a merge.
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("m", "x").inc()
+    b.gauge("m", "x").set(1)
+    with pytest.raises(ValueError, match="disagrees"):
+        merge_registries({"0": a, "1": b})
+    # Histogram bucket-layout mismatch refuses too.
+    c, d = MetricsRegistry(), MetricsRegistry()
+    c.histogram("h", buckets=(0.1, 1.0)).observe(0.2)
+    d.histogram("h", buckets=(0.5, 5.0)).observe(0.2)
+    with pytest.raises(ValueError, match="bucket"):
+        merge_registries({"0": c, "1": d})
+    # ...including across DISJOINT label sets (family-level check: a
+    # collision-gated check would let two layouts smuggle into one
+    # family and corrupt the rebuilt exposition).
+    c2, d2 = MetricsRegistry(), MetricsRegistry()
+    c2.histogram("h2", labels=("shard",), buckets=(0.1, 1.0)).labels(
+        shard="0"
+    ).observe(0.2)
+    d2.histogram("h2", labels=("shard",), buckets=(0.5, 5.0)).labels(
+        shard="1"
+    ).observe(0.2)
+    with pytest.raises(ValueError, match="bucket"):
+        merge_registries({"0": c2, "1": d2})
+    # Two devices claiming one gauge device-label value collide loudly.
+    e, f = MetricsRegistry(), MetricsRegistry()
+    e.gauge("g", labels=("device",)).labels(device="x").set(1)
+    f.gauge("g", labels=("device",)).labels(device="x").set(2)
+    with pytest.raises(ValueError, match="device"):
+        merge_registries({"0": e, "1": f})
+
+
+def test_engine_device_registries_merge_to_global_totals():
+    """key_shard.shard_stats -> per-device registries -> one merged
+    exposition whose counters reproduce the global reduction."""
+    query = compile_query(compile_pattern(letters_pattern()), None)
+    bat = BatchedDeviceNFA(
+        query, keys=["x", "y"],
+        config=EngineConfig(lanes=8, nodes=128, matches=16),
+    )
+    bat.advance({"x": letter_stream(3, 6, key="x"),
+                 "y": letter_stream(4, 6, key="y")})
+    merged = merge_registries(bat.device_registries())
+    snap = merged.snapshot()
+    totals = {
+        v["labels"]["counter"]: v["value"]
+        for v in snap["cep_device_state_total"]["values"]
+    }
+    assert totals["n_events"] == bat.stats["n_events"] == 12
+    assert snap["cep_device_runs"]["label_names"] == ["device"]
+
+
+# ------------------------------------------------------------- HTTP plane
+def test_http_endpoints_serve_registry_tracer_health():
+    reg = MetricsRegistry()
+    reg.counter("c_total", "c").inc(5)
+    tracer = SpanTracer(reg)
+    with tracer.span("restore"):
+        pass
+    exemplars = [{"query": "q", "stage_path": ["a"], "key": "K"}]
+    with IntrospectionServer(
+        registry=reg, tracer=tracer,
+        health_fn=lambda: {"group": "g"},
+        match_exemplars=lambda n: exemplars[:n],
+    ) as srv:
+        text = _get(srv.url + "/metrics").decode()
+        assert parse_prom_text(text)["c_total"][()] == 5
+        snap = _get_json(srv.url + "/snapshot")
+        assert snap["c_total"]["values"][0]["value"] == 5
+        # /metrics and /snapshot carry the same values (the acceptance's
+        # wire-vs-artifact agreement) -- rebuilt snapshot renders the
+        # identical exposition.
+        assert registry_from_snapshot(snap).to_prom_text() == text
+        hz = _get_json(srv.url + "/healthz")
+        assert hz["status"] == "ok"
+        assert hz["group"] == "g"
+        assert hz["faults_armed"] is False
+        tz = _get_json(srv.url + "/tracez")
+        assert tz["kind"] == "span"
+        assert tz["spans"][0]["span"] == "restore"
+        assert tz["spans"][0]["duration_s"] >= 0
+        mz = _get_json(srv.url + "/tracez?kind=match&limit=8")
+        assert mz == {"kind": "match", "matches": exemplars}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/nope")
+        assert ei.value.code == 404
+
+
+def test_http_server_restart_keeps_ticking():
+    """stop() then start() must revive the clock thread (a set _stop
+    event would kill it on its first wait -- silently, since HTTP keeps
+    answering)."""
+    ticks = []
+    srv = IntrospectionServer(
+        registry=MetricsRegistry(),
+        tick_fns=(lambda: ticks.append(1),), tick_every_s=0.01,
+    )
+    srv.start()
+    deadline = time.time() + 5.0
+    while not ticks and time.time() < deadline:
+        time.sleep(0.005)
+    srv.stop()
+    n = len(ticks)
+    assert n >= 1
+    srv.start()
+    try:
+        deadline = time.time() + 5.0
+        while len(ticks) <= n and time.time() < deadline:
+            time.sleep(0.005)
+        assert len(ticks) > n, "restarted server's clock thread never ticked"
+    finally:
+        srv.stop()
+
+
+def _letters_pipeline(runtime, registry, log, **opts):
+    builder = ComplexStreamsBuilder(log=log, app_id="intro")
+    builder.stream("letters").query(
+        "q", letters_pattern(), runtime=runtime, registry=registry, **opts
+    ).to("matches")
+    return builder.build()
+
+
+def test_idle_driver_reports_on_time_via_clock_thread():
+    """Regression (ISSUE 7 satellite): report_every_s on an idle topic
+    used to never fire -- the check lived on the poll path only. The HTTP
+    plane's clock thread now drives it on wall time."""
+    log = RecordLog()
+    reg = MetricsRegistry()
+    topo = _letters_pipeline("host", reg, log)
+    reports = []
+    driver = LogDriver(
+        topo, group="idle", registry=reg,
+        report_every_s=0.03, reporter=reports.append,
+    )
+    # No records, no polls: the poll path alone would never report.
+    srv = driver.serve_http()
+    try:
+        deadline = time.time() + 5.0
+        while not reports and time.time() < deadline:
+            time.sleep(0.01)
+        assert reports, "idle topic never reported (poll-gated cadence)"
+        assert "cep_driver_polls_total" in reports[0]
+        # The reports counter moved without a single poll.
+        snap = reg.snapshot()
+        vals = {
+            v["labels"]["group"]: v["value"]
+            for v in snap["cep_driver_reports_total"]["values"]
+        }
+        assert vals["idle"] >= 1
+        polls = {
+            v["labels"]["group"]: v["value"]
+            for v in snap["cep_driver_polls_total"]["values"]
+        }
+        assert polls["idle"] == 0
+    finally:
+        srv.stop()
+
+
+def test_driver_healthz_liveness_fields():
+    log = RecordLog()
+    for i, ch in enumerate("XABC"):
+        produce(log, "letters", "K", ch, timestamp=i)
+    reg = MetricsRegistry()
+    topo = _letters_pipeline("host", reg, log)
+    driver = LogDriver(topo, group="hz", registry=reg)
+    srv = driver.serve_http()
+    try:
+        hz = _get_json(srv.url + "/healthz")
+        assert hz["last_poll_age_s"] is None  # no poll yet
+        driver.poll()
+        hz = _get_json(srv.url + "/healthz")
+        assert hz["polls"] == 1 and hz["records"] == 4
+        assert hz["last_poll_age_s"] is not None
+        assert hz["last_commit_age_s"] is not None
+        assert hz["last_commit_age_s"] < 60
+        assert hz["faults_armed"] is False
+        assert hz["restore_failures"] == 0
+        # The driver's restore/commit spans surface on /tracez.
+        tz = _get_json(srv.url + "/tracez")
+        spans = {s["span"] for s in tz["spans"]}
+        assert {"restore", "commit"} <= spans
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------ match latency
+@pytest.mark.parametrize("runtime,opts", [
+    ("host", {}),
+    ("tpu", dict(
+        config=EngineConfig(lanes=8, nodes=128, matches=16),
+        batch_size=4, initial_keys=1,
+    )),
+])
+def test_match_latency_histogram_ingest_to_emission(runtime, opts):
+    """cep_match_latency_seconds{query}: one sample per sink-emitted
+    match, anchored at the driver's poll-time ingest stamp -- on both
+    runtimes (the device path rides the flat-drain decode; stamping is
+    pure host state)."""
+    log = RecordLog()
+    for i, ch in enumerate("XABCABC"):
+        produce(log, "letters", "K", ch, timestamp=i)
+    reg = MetricsRegistry()
+    topo = _letters_pipeline(runtime, reg, log, **opts)
+    driver = LogDriver(topo, group="lat", registry=reg)
+    driver.poll()
+    snap = reg.snapshot()
+    fam = snap["cep_match_latency_seconds"]
+    vals = {v["labels"]["query"]: v for v in fam["values"]}
+    assert vals["q"]["count"] == 2  # ABC completes twice
+    assert vals["q"]["sum"] >= 0
+    # Replayed records below the HWM never re-observe: polling the same
+    # stream again emits nothing new.
+    driver.poll()
+    snap = reg.snapshot()
+    vals = {
+        v["labels"]["query"]: v
+        for v in snap["cep_match_latency_seconds"]["values"]
+    }
+    assert vals["q"]["count"] == 2
+
+
+def test_ingest_stamps_full_identity_and_bounded_eviction():
+    """Stamps key on the full event identity -- (key, offset) alone
+    collides across topics/partitions -- and evict oldest-first in O(1)."""
+    log = RecordLog()
+    topo = _letters_pipeline("host", MetricsRegistry(), log)
+    topo.stamp_ingest("a", 0, "K", 5, 100.0)
+    topo.stamp_ingest("b", 0, "K", 5, 200.0)  # same (key, offset), other topic
+    assert topo._ingest_stamps[("a", 0, "K", 5)] == 100.0
+    assert topo._ingest_stamps[("b", 0, "K", 5)] == 200.0
+    topo.INGEST_STAMPS_MAX = 3  # instance override for the bound
+    for i in range(6):
+        topo.stamp_ingest("a", 0, "K", 100 + i, float(i))
+    assert len(topo._ingest_stamps) == 3
+    assert ("a", 0, "K", 105) in topo._ingest_stamps
+    assert ("a", 0, "K", 5) not in topo._ingest_stamps  # oldest evicted
+
+
+def test_direct_process_without_stamp_skips_latency():
+    """Topology.process outside a driver (no ingest stamp) emits matches
+    but records no latency sample -- no stamp, no fabricated number."""
+    log = RecordLog()
+    reg = MetricsRegistry()
+    topo = _letters_pipeline("host", reg, log)
+    for i, ch in enumerate("ABC"):
+        topo.process("letters", "K", ch, timestamp=i, offset=i)
+    snap = reg.snapshot()
+    assert snap["cep_processor_matches_total"]["values"][0]["value"] == 1
+    assert snap["cep_match_latency_seconds"]["values"][0]["count"] == 0
+
+
+# -------------------------------------------------------------- provenance
+def _oracle_sequences(stream):
+    stages = compile_pattern(letters_pattern())
+    nfa = NFA.build(stages, AggregatesStore(), SharedVersionedBuffer())
+    out = []
+    for e in stream:
+        out.extend(nfa.match_pattern(e))
+    return out
+
+
+def _lineage(seq):
+    p = sequence_provenance(seq)
+    return (p.stage_path, p.chain_depth, p.branch_depth,
+            p.first_offset, p.last_offset,
+            p.first_timestamp, p.last_timestamp)
+
+
+@pytest.mark.parametrize("engine,drain_mode", [
+    ("xla", "flat"),
+    ("xla", "pool"),
+    ("pallas_interpret", "flat"),
+    ("pallas_interpret", "pool"),
+])
+def test_provenance_differential_vs_host_oracle(engine, drain_mode):
+    """Satellite: the sampled lineage (stage path, window offsets, chain
+    depth) agrees with the host-oracle NFA run for the same stream, on
+    both step engines and both drain modes."""
+    n = 24 if engine == "xla" else 15
+    # ABC runs embedded in noise: strict contiguity completes one match
+    # per 5-event block, and the tail blocks straddle the advance splits.
+    stream = [
+        Event("K", "ABC"[i % 5] if i % 5 < 3 else "XY"[i % 2], TS + i,
+              "t", 0, i)
+        for i in range(n)
+    ]
+    want = sorted(_lineage(s) for s in _oracle_sequences(stream))
+    assert want, "oracle produced no matches -- test stream broken"
+    query = compile_query(compile_pattern(letters_pattern()), None)
+    bat = BatchedDeviceNFA(
+        query, keys=["K"],
+        config=EngineConfig(lanes=8, nodes=256, matches=256,
+                            matches_per_step=4, nodes_per_step=8),
+        engine=engine, drain_mode=drain_mode,
+        provenance_sample=1.0, query_name="q",
+    )
+    got = []
+    for lo, hi in ((0, 6), (6, 11), (11, 100)):
+        chunk = stream[lo:hi]
+        if chunk:
+            for seqs in bat.advance({"K": chunk}).values():
+                got.extend(seqs)
+    # Every decoded match carries provenance at sample=1.0, with the
+    # right query/trigger attribution...
+    assert got and all(s.provenance is not None for s in got)
+    assert all(s.provenance.query == "q" for s in got)
+    assert all(s.provenance.trigger == "drain" for s in got)
+    # ...whose lineage is the oracle's, field for field.
+    device = sorted(
+        (s.provenance.stage_path, s.provenance.chain_depth,
+         s.provenance.branch_depth,
+         s.provenance.first_offset, s.provenance.last_offset,
+         s.provenance.first_timestamp, s.provenance.last_timestamp)
+        for s in got
+    )
+    assert device == want
+    # The exemplar ring serves the same lineage as JSON-ready dicts.
+    ex = bat.provenance_exemplars(256)
+    assert len(ex) == len(got)
+    assert all(e["key"] == "K" for e in ex)
+
+
+def test_provenance_stride_sampling_deterministic():
+    """rate r samples exactly every 1/r-th decoded match (stride
+    accumulator, not RNG): rate 0.5 over 2k matches -> exactly k."""
+    stream = []
+    for b in range(8):
+        for i, ch in enumerate("ABC"):
+            stream.append(Event("K", ch, TS + 10 * b + i, "t", 0, 10 * b + i))
+    query = compile_query(compile_pattern(letters_pattern()), None)
+    bat = BatchedDeviceNFA(
+        query, keys=["K"],
+        config=EngineConfig(lanes=8, nodes=128, matches=64),
+        provenance_sample=0.5,
+    )
+    got = []
+    for seqs in bat.advance({"K": stream}).values():
+        got.extend(seqs)
+    assert len(got) == 8
+    sampled = [s for s in got if s.provenance is not None]
+    assert len(sampled) == 4
+    assert len(bat.provenance_exemplars()) == 4
+    # sample=0 never attaches and the ring stays empty.
+    bat0 = BatchedDeviceNFA(
+        query, keys=["K"],
+        config=EngineConfig(lanes=8, nodes=128, matches=64),
+    )
+    out0 = [s for seqs in bat0.advance({"K": stream}).values() for s in seqs]
+    assert all(s.provenance is None for s in out0)
+    assert bat0.provenance_exemplars() == []
+
+
+def test_device_pipeline_exemplars_surface_user_keys():
+    """Through the streams stack the exemplar keys are the record keys
+    (lane handles unwrapped), and /tracez?kind=match serves them."""
+    log = RecordLog()
+    for i, ch in enumerate("XABC"):
+        produce(log, "letters", "KEY-7", ch, timestamp=i)
+    reg = MetricsRegistry()
+    topo = _letters_pipeline(
+        "tpu", reg, log,
+        config=EngineConfig(lanes=8, nodes=128, matches=16),
+        batch_size=4, initial_keys=1, provenance_sample=1.0,
+    )
+    driver = LogDriver(topo, group="prov", registry=reg)
+    srv = driver.serve_http()
+    try:
+        driver.poll()
+        mz = _get_json(srv.url + "/tracez?kind=match")
+        assert mz["matches"], "no exemplars surfaced"
+        ex = mz["matches"][0]
+        assert ex["key"] == "KEY-7"
+        assert ex["query"] == "q"
+        assert ex["stage_path"] == ["a", "b", "c"]
+        assert ex["first_offset"] == 1 and ex["last_offset"] == 3
+    finally:
+        srv.stop()

@@ -44,6 +44,10 @@ sys.path.insert(
 )
 from check_bench_schema import validate as validate_bench_schema  # noqa: E402
 
+# `pytest -m obs` selects the observability suite (mirrors `-m chaos`);
+# the marker rides tier-1 (fast, deterministic, CPU-safe).
+pytestmark = pytest.mark.obs
+
 
 def letters_pattern():
     return (
@@ -262,9 +266,14 @@ def test_advance_zero_device_syncs_with_metrics_enabled(monkeypatch):
     # (probes dispatch -- asynchronously) but can never force a pull in
     # this window, whatever the probe landing order.
     query = compile_query(compile_pattern(letters_pattern()), None)
+    # provenance_sample=1.0: lineage sampling rides the decode worker, so
+    # the zero-sync advance contract must hold with it armed (ISSUE 7
+    # acceptance; latency stamping is host-side at the streams layer and
+    # never touches the engine).
     bat = BatchedDeviceNFA(
         query, keys=["x"],
         config=EngineConfig(lanes=8, nodes=128, matches=1024),
+        provenance_sample=1.0,
     )
     # Warm every jitted program incl. a match-bearing drain OUTSIDE the
     # counted window.
@@ -289,7 +298,8 @@ def test_advance_zero_device_syncs_with_metrics_enabled(monkeypatch):
     real_pull = bat._pull_raw
     monkeypatch.setattr(
         bat, "_pull_raw",
-        lambda: calls.__setitem__("pull", calls["pull"] + 1) or real_pull(),
+        lambda **kw: calls.__setitem__("pull", calls["pull"] + 1)
+        or real_pull(**kw),
     )
 
     # Match-free stream: noise letters only.
@@ -460,6 +470,23 @@ def _valid_artifact():
         # ISSUE 6: the fault/robustness block -- all FAULT_SERIES keys,
         # all-zero in a healthy artifact.
         "faults": fault_series_totals(MetricsRegistry()),
+        # ISSUE 7: end-to-end match-latency block (None outside --smoke),
+        # observation self-description, merged cross-registry snapshot.
+        "latency": {
+            "query": "q-intro",
+            "count": 2,
+            "sum_s": 0.25,
+            "p50_ms": 100.0,
+            "p99_ms": 200.0,
+            "buckets": {"0.5": 2, "+Inf": 2},
+        },
+        "observation": {
+            "provenance_sample": 0.01,
+            "http_server": True,
+            "http_endpoints_ok": True,
+            "served_matches_snapshot": True,
+        },
+        "metrics_merged": reg.snapshot(),
     }
 
 
@@ -481,6 +508,33 @@ def test_bench_schema_rejects_missing_and_undocumented_keys():
     errors = validate_bench_schema(art2)
     assert any("post_ms" in e for e in errors)
     assert any("extra_ms" in e for e in errors)
+
+
+def test_bench_schema_validates_observation_and_latency_blocks():
+    # observation: documented keys both ways.
+    art = _valid_artifact()
+    del art["observation"]["http_server"]
+    art["observation"]["surprise"] = 1
+    errors = validate_bench_schema(art)
+    assert any("http_server" in e for e in errors)
+    assert any("surprise" in e for e in errors)
+    # latency: None is the documented non-smoke shape...
+    art2 = _valid_artifact()
+    art2["latency"] = None
+    assert validate_bench_schema(art2) == []
+    # ...but a populated block must carry every documented key.
+    art3 = _valid_artifact()
+    del art3["latency"]["count"]
+    art3["latency"]["extra"] = 1
+    errors = validate_bench_schema(art3)
+    assert any("latency" in e and "count" in e for e in errors)
+    assert any("extra" in e for e in errors)
+    # The merged cross-registry snapshot round-trips like `metrics`.
+    art4 = _valid_artifact()
+    fam = art4["metrics_merged"]["cep_drain_seconds"]["values"][0]
+    fam["count"] = fam["count"] + 3
+    errors = validate_bench_schema(art4)
+    assert any("metrics_merged round-trip" in e for e in errors)
 
 
 def test_bench_schema_catches_metrics_roundtrip_corruption():
