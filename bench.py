@@ -69,6 +69,21 @@ def parse_args() -> argparse.Namespace:
         "--engine", default="auto", choices=["auto", "xla", "pallas"],
         help="batched engine: fused pallas kernel (TPU) or the XLA scan step",
     )
+    ap.add_argument(
+        "--compare", default=None, metavar="PRIOR_JSON",
+        help="prior bench artifact (any shape scripts/perf_ledger.py "
+        "ingests): emits a `regression` block with per-config eps deltas, "
+        "flagged beyond the tolerance unless tunnel_degraded excuses them",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=0.15,
+        help="fractional eps drop --compare flags as a regression",
+    )
+    ap.add_argument(
+        "--trace-out", default=None, metavar="TRACE_JSON",
+        help="write the introspection pass's Chrome-trace/Perfetto "
+        "timeline (spans + match exemplars) here (--smoke only)",
+    )
     return ap.parse_args()
 
 
@@ -129,6 +144,16 @@ PROVENANCE_SAMPLE = 0.01
 
 def log(msg: str) -> None:
     print(f"[bench +{time.perf_counter() - _T_START:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def _ensure_scripts_on_path() -> None:
+    """Make scripts/ importable (check_bench_schema, perf_ledger) exactly
+    once, wherever bench.py is launched from."""
+    scripts = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"
+    )
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
 
 
 _T_START = time.perf_counter()
@@ -356,6 +381,11 @@ def bench_device_batched(
     bat = BatchedDeviceNFA(
         query, keys=[f"k{i}" for i in range(n_keys)], config=config,
         engine=ARGS.engine, provenance_sample=PROVENANCE_SAMPLE,
+        # Arm cost_analysis() estimates here (off by default: the extra
+        # lowering per signature doubles trace time): the bench pays one
+        # retrace per program so the artifact's `compile` block carries
+        # FLOPs/bytes alongside counts and walls.
+        compile_cost_estimates=True,
     )
     rng = random.Random(7)
     n_warm = 2  # warmup batches (compiles incl. a match-bearing drain)
@@ -625,6 +655,10 @@ def bench_introspection() -> Dict[str, Any]:
 
     - /metrics, /snapshot, /healthz and /tracez answer while the stream
       is flowing (the acceptance's curl-mid-stream contract);
+    - /tracez?format=chrome serves a loadable Chrome-trace document
+      (ISSUE 9: traceEvents is a list of well-formed events) and
+      /profilez?secs=0 arms-and-completes an on-demand capture without
+      failing the pipeline (the degraded-profiler path no-ops);
     - after the run, the SERVED prom text value-matches the final JSON
       snapshot (wire view == artifact view -- the reporter is disarmed
       first so no counter moves between the fetch and the snapshot);
@@ -686,6 +720,50 @@ def bench_introspection() -> Dict[str, Any]:
             except Exception as exc:
                 log(f"introspection route {route} failed: {exc}")
                 endpoints_ok = False
+        # Timeline export (ISSUE 9): the chrome-format /tracez must parse
+        # as a Chrome-trace document whose traceEvents is an array of
+        # well-formed events (name/ph/ts) -- the Perfetto load contract.
+        chrome_ok = False
+        chrome_events = 0
+        chrome_doc = None
+        try:
+            chrome_doc = json.loads(
+                urllib.request.urlopen(
+                    srv.url + "/tracez?format=chrome&limit=512", timeout=10
+                ).read()
+            )
+            events = chrome_doc.get("traceEvents")
+            chrome_ok = (
+                isinstance(events, list)
+                and len(events) > 0
+                and all(
+                    isinstance(e, dict)
+                    and "name" in e and "ph" in e
+                    and ("ts" in e or e.get("ph") == "M")
+                    for e in events
+                )
+            )
+            chrome_events = len(events) if isinstance(events, list) else 0
+        except Exception as exc:
+            log(f"introspection /tracez?format=chrome failed: {exc}")
+        if ARGS.trace_out and chrome_doc is not None:
+            with open(ARGS.trace_out, "w") as f:
+                json.dump(chrome_doc, f)
+            log(f"chrome trace written to {ARGS.trace_out}")
+        # On-demand device capture: arm a zero-second profile; the reply
+        # must arrive whether the profiler is available (capture runs on
+        # a background thread) or degraded (no-op + warning gauge).
+        profilez_armed = None
+        try:
+            pz = json.loads(
+                urllib.request.urlopen(
+                    srv.url + "/profilez?secs=0", timeout=10
+                ).read()
+            )
+            profilez_armed = bool(pz.get("armed"))
+        except Exception as exc:
+            log(f"introspection /profilez failed: {exc}")
+            profilez_armed = False
         for e in stream[64:]:
             produce(rlog, "letters", e.key, e.value, timestamp=e.timestamp)
         driver.poll()
@@ -727,10 +805,83 @@ def bench_introspection() -> Dict[str, Any]:
         http_routes=mid_routes,
         http_endpoints_ok=endpoints_ok,
         served_matches_snapshot=served_matches_snapshot,
+        chrome_trace_ok=chrome_ok,
+        chrome_trace_events=chrome_events,
+        profilez_armed=profilez_armed,
         provenance_exemplars=n_exemplars,
         match_latency=lat_block,
         metrics=final_snap,
     )
+
+
+def _compile_block(flagship_metrics: Dict[str, Any]) -> Dict[str, Any]:
+    """The artifact's `compile` block (ISSUE 9): per-entry-point compile
+    telemetry from the flagship engine's registry snapshot -- compile
+    count, first-call wall, and cost_analysis() FLOPs/bytes estimates.
+    Cost drift and recompile storms become diffable numbers in BENCH_r*
+    instead of log archaeology."""
+    def _by_fn(family: str, field: str) -> Dict[str, float]:
+        fam = flagship_metrics.get(family) or {}
+        out: Dict[str, float] = {}
+        for entry in fam.get("values", ()):
+            fn = entry.get("labels", {}).get("fn")
+            if fn is not None and field in entry:
+                out[fn] = float(entry[field])
+        return out
+
+    compiles = _by_fn("cep_compiles_total", "value")
+    seconds = _by_fn("cep_compile_seconds", "sum")
+    flops = _by_fn("cep_compile_flops", "value")
+    nbytes = _by_fn("cep_compile_bytes", "value")
+    fns = {
+        fn: {
+            "compiles": compiles.get(fn, 0.0),
+            "seconds": seconds.get(fn, 0.0),
+            "flops": flops.get(fn),
+            "bytes": nbytes.get(fn),
+        }
+        for fn in sorted(set(compiles) | set(seconds))
+    }
+    return {
+        "fns": fns,
+        "total_compiles": sum(compiles.values()),
+        "total_seconds": sum(seconds.values()),
+    }
+
+
+def _regression_block(detail: Dict[str, Any], tunnel_degraded: bool):
+    """The artifact's `regression` block: deltas vs the --compare prior
+    (None when --compare was not given). tunnel_degraded on EITHER side
+    excuses flags -- environment noise must not fail the check."""
+    if ARGS.compare is None:
+        return None
+    _ensure_scripts_on_path()
+    from perf_ledger import compare_artifacts, load_artifact
+
+    prior = load_artifact(ARGS.compare)
+    cur = {"configs": detail, "tunnel_degraded": tunnel_degraded}
+    block = compare_artifacts(
+        prior, cur, tolerance=ARGS.tolerance, prior_name=ARGS.compare
+    )
+    if block["regressed"]:
+        verdict = "EXCUSED (tunnel_degraded)" if block["excused"] else "REGRESSED"
+        log(f"--compare vs {ARGS.compare}: {verdict}")
+        for name, entry in block["configs"].items():
+            for metric, d in entry.items():
+                if d["regressed"]:
+                    log(
+                        f"  {name}.{metric}: {d['prev']:.0f} -> "
+                        f"{d['cur']:.0f} ({d['delta_pct']:+.1f}%)"
+                    )
+    else:
+        log(f"--compare vs {ARGS.compare}: no regression beyond "
+            f"{ARGS.tolerance:.0%}")
+    if block["missing_configs"]:
+        log(
+            "  prior configs absent from this run (reported, not "
+            f"compared): {', '.join(block['missing_configs'])}"
+        )
+    return block
 
 
 def _fault_block(flagship_metrics: Dict[str, Any]) -> Dict[str, float]:
@@ -1009,7 +1160,23 @@ def main() -> None:
                 intro_detail.get("served_matches_snapshot")
                 if ARGS.smoke else None
             ),
+            # ISSUE 9: the timeline-export and on-demand-profile planes
+            # proved live against the smoke pipeline (None outside it).
+            "chrome_trace_ok": (
+                intro_detail.get("chrome_trace_ok") if ARGS.smoke else None
+            ),
+            "profilez_armed": (
+                intro_detail.get("profilez_armed") if ARGS.smoke else None
+            ),
         },
+        # Compile-cost telemetry (ISSUE 9): per-entry-point compile
+        # count/wall and cost_analysis() estimates from the flagship
+        # engine's compile watch (obs/compile.py).
+        "compile": _compile_block(flagship_metrics),
+        # Perf-regression verdict vs a --compare prior artifact (None
+        # without --compare); scripts/perf_ledger.py computes the same
+        # deltas over whole BENCH_r* trajectories.
+        "regression": _regression_block(detail, tunnel_degraded),
         # The merged cross-registry exposition (obs/merge.py), None
         # outside --smoke.
         "metrics_merged": metrics_merged,
@@ -1039,10 +1206,7 @@ def main() -> None:
         # Smoke artifacts must stay self-describing: validate the JSON
         # contract (documented keys, component breakdown, metrics
         # round-trip) before printing, and fail the run on violations.
-        sys.path.insert(
-            0,
-            os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"),
-        )
+        _ensure_scripts_on_path()
         from check_bench_schema import validate as _validate_schema
 
         errors = _validate_schema(out)

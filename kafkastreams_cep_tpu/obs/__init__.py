@@ -7,6 +7,7 @@ them as Prometheus 0.0.4 text (`to_prom_text`) or a JSON snapshot
 scripts/check_bench_schema.py validates. See PERF.md "v10" for the full
 metrics dictionary.
 """
+from .compile import CompileWatch
 from .http import IntrospectionServer
 from .merge import merge_registries, merge_snapshots
 from .registry import (
@@ -21,9 +22,13 @@ from .registry import (
     registry_from_snapshot,
 )
 from .trace import SpanTracer
+from .trace_export import chrome_trace, write_chrome_trace
 
 __all__ = [
+    "CompileWatch",
     "Counter",
+    "chrome_trace",
+    "write_chrome_trace",
     "FAULT_SERIES",
     "Gauge",
     "Histogram",

@@ -1,0 +1,182 @@
+"""Compile-cost telemetry: observe XLA compiles per (function, signature).
+
+ROADMAP item 3's compile cache and every retrace-sensitive path (the
+flatten fns compile per pow2 bucket, add_keys retraces per key extent)
+share one blind spot: nothing counted compiles or their cost, so a
+recompile storm looked like generic slowness. The `CompileWatch` shim
+wraps a jitted callable and watches its *shape signatures*: the first
+call under a new signature is exactly when XLA traces + compiles, so its
+wall is recorded as the compile observation for that (function,
+signature) pair, and `Lowered.cost_analysis()` contributes FLOPs/bytes
+estimates when the backend provides them.
+
+Registry series (PERF.md v13):
+
+- ``cep_compiles_total{fn}``        new-signature observations (compiles)
+- ``cep_compile_seconds{fn}``       first-call wall per compile (histogram;
+                                    trace + XLA compile + first dispatch --
+                                    an upper bound on pure compile)
+- ``cep_compile_flops{fn}``         latest cost_analysis() FLOPs estimate
+- ``cep_compile_bytes{fn}``         latest cost_analysis() bytes-accessed
+
+Hot-path contract: a warm call (signature already seen) pays one
+host-side signature probe -- tree_flatten over the arg pytree plus a
+LOCK-FREE dict membership test on shape/dtype metadata (dict reads are
+GIL-atomic; the lock guards only the miss path); no device sync, no
+retrace -- so the zero-sync advance pin holds with the shim armed
+(tests/test_obs.py). The cost_analysis lowering runs once per new
+signature and is best-effort: any failure (pallas lowerings, backends
+without cost models) degrades to None, never an exception on the data
+path.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .registry import MetricsRegistry, default_registry
+
+__all__ = ["CompileWatch", "shape_signature"]
+
+#: Compile-wall-flavored buckets (seconds): CPU smoke compiles land
+#: ~10-100 ms, flagship TPU plane compiles run to minutes.
+COMPILE_BUCKETS = (
+    0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0,
+)
+
+
+def shape_signature(args: Tuple[Any, ...]) -> Tuple[Any, ...]:
+    """Hashable (treedef, leaf shape/dtype) signature of a call's args --
+    the same information jit keys its cache on, read from host-side
+    metadata only (never the device)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    # dtype objects (np.dtype) are hashable -- no per-call string
+    # construction on the warm path; non-array leaves key on their type.
+    return (
+        treedef,
+        tuple(
+            (getattr(l, "shape", None), getattr(l, "dtype", None) or type(l))
+            for l in leaves
+        ),
+    )
+
+
+class CompileWatch:
+    """Wrap jitted entry points; record compile count/wall/cost per
+    (function label, shape signature) into `registry`.
+
+    One watch per engine instance (it rides the engine's registry); the
+    `seen` map is guarded by a lock because drain-side fns run on the
+    decode worker while the advance path runs on the caller's thread.
+    """
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        estimate_cost: bool = True,
+    ) -> None:
+        self.registry = registry if registry is not None else default_registry()
+        self.estimate_cost = estimate_cost
+        self._seen: Dict[Tuple[str, int, Any], bool] = {}
+        self._lock = threading.Lock()
+        self._wrap_ids = itertools.count()
+        r = self.registry
+        self._m_compiles = r.counter(
+            "cep_compiles_total",
+            "New-shape-signature observations (XLA compiles) per entry point",
+            labels=("fn",),
+        )
+        self._m_seconds = r.histogram(
+            "cep_compile_seconds",
+            "First-call wall per compile (trace + compile + first dispatch)",
+            labels=("fn",),
+            buckets=COMPILE_BUCKETS,
+        )
+        self._m_flops = r.gauge(
+            "cep_compile_flops",
+            "cost_analysis() FLOPs estimate of the latest compile",
+            labels=("fn",),
+        )
+        self._m_bytes = r.gauge(
+            "cep_compile_bytes",
+            "cost_analysis() bytes-accessed estimate of the latest compile",
+            labels=("fn",),
+        )
+
+    # ------------------------------------------------------------------ API
+    def compiles(self, fn: str) -> int:
+        """Observed compiles for one label (test/introspection helper)."""
+        return int(self._m_compiles.labels(fn=fn).value)
+
+    @property
+    def seen_count(self) -> int:
+        """Distinct (program, signature) pairs observed so far -- a cheap
+        'did anything compile since I last looked' probe (len() is
+        GIL-atomic; the engine's sampled phase profiling uses it to keep
+        compile walls out of the compute histograms)."""
+        return len(self._seen)
+
+    def wrap(self, fn: Callable, name: str) -> Callable:
+        """The instrumented callable: pass-through semantics, compile
+        observations on new shape signatures.
+
+        The seen-key carries a per-wrap token alongside the label: two
+        DISTINCT programs under one label (the per-(Mb, Cb) flatten
+        buckets; a rebuilt advance after the pallas fallback) are
+        separate compiles even when their arg shapes coincide -- bucket
+        churn is exactly the recompile storm this watch must show."""
+        token = next(self._wrap_ids)
+
+        def wrapped(*args: Any) -> Any:
+            try:
+                sig = (name, token, shape_signature(args))
+            except Exception:
+                return fn(*args)  # unhashable arg tree: observe nothing
+            # Lock-free warm path: dict membership is GIL-atomic, and a
+            # stale miss only routes through the locked miss path below.
+            if sig in self._seen:
+                return fn(*args)
+            t0 = time.perf_counter()
+            out = fn(*args)
+            dt = time.perf_counter() - t0
+            with self._lock:
+                first = sig not in self._seen
+                self._seen[sig] = True
+            if first:
+                self._m_compiles.labels(fn=name).inc()
+                self._m_seconds.labels(fn=name).observe(dt)
+                self._estimate(fn, name, args)
+            return out
+
+        wrapped.__name__ = f"compile_watch[{name}]"
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    def _estimate(self, fn: Callable, name: str, args: Tuple[Any, ...]) -> None:
+        """Best-effort cost_analysis() on the already-compiled signature:
+        the jit cache is warm, so .lower() re-traces but never re-compiles
+        XLA; failures (no .lower, pallas, backend without a cost model)
+        leave the gauges untouched."""
+        if not self.estimate_cost:
+            return
+        lower = getattr(fn, "lower", None)
+        if lower is None:
+            return
+        try:
+            cost = lower(*args).cost_analysis()
+            if isinstance(cost, (list, tuple)):  # per-device variants
+                cost = cost[0] if cost else None
+            if not cost:
+                return
+            flops = cost.get("flops")
+            if flops is not None:
+                self._m_flops.labels(fn=name).set(float(flops))
+            nbytes = cost.get("bytes accessed")
+            if nbytes is not None:
+                self._m_bytes.labels(fn=name).set(float(nbytes))
+        except Exception:
+            pass

@@ -11,7 +11,15 @@ thread and serves, with zero third-party dependencies:
                  restore state, fault-arm state)
 - ``/tracez``    recent SpanTracer spans as JSON (newest first);
                  ``?kind=match`` serves sampled match-provenance
-                 exemplars instead; ``?limit=N`` bounds either
+                 exemplars instead; ``?limit=N`` bounds either;
+                 ``?format=chrome`` renders spans AND exemplars as one
+                 Chrome-trace/Perfetto document (obs/trace_export.py)
+- ``/profilez``  ``?secs=N`` arms an on-demand device xplane capture
+                 (ops.profiling.device_trace) for N seconds on a
+                 background thread against the running pipeline; the
+                 reply returns immediately with the capture's log_dir.
+                 One capture at a time; a degraded profiler (no TPU /
+                 missing plugin) no-ops with a persistent warning gauge
 
 The server also owns the plane's **clock thread**: callables registered
 via `tick_fns` run every `tick_every_s` seconds regardless of stream
@@ -87,7 +95,13 @@ class IntrospectionServer:
     /tracez?kind=match (e.g. BatchedDeviceNFA.provenance_exemplars).
     `tick_fns`: called from the clock thread every `tick_every_s` --
     idle-stream periodic reporting lives here, not on the poll path.
+    `profile_dir`: where /profilez drops xplane captures (a fresh temp
+    dir per capture under the system tmp dir when omitted).
     """
+
+    #: /profilez duration clamp: a runaway ?secs= must not pin the
+    #: profiler (and its buffer memory) for hours.
+    PROFILE_MAX_SECS = 60.0
 
     def __init__(
         self,
@@ -99,6 +113,7 @@ class IntrospectionServer:
         tick_every_s: float = 0.25,
         host: str = "127.0.0.1",
         port: int = 0,
+        profile_dir: Optional[str] = None,
     ) -> None:
         self.registry = registry if registry is not None else default_registry()
         self.tracer = tracer if tracer is not None else SpanTracer(self.registry)
@@ -114,11 +129,16 @@ class IntrospectionServer:
         self._stop = threading.Event()
         self._t_start = time.time()
         self.requests = 0
+        self.profile_dir = profile_dir
+        self._profile_thread: Optional[threading.Thread] = None
+        self._profile_lock = threading.Lock()
+        self.profile_captures = 0
         self._routes: Dict[str, Callable] = {
             "/metrics": self._route_metrics,
             "/snapshot": self._route_snapshot,
             "/healthz": self._route_healthz,
             "/tracez": self._route_tracez,
+            "/profilez": self._route_profilez,
         }
 
     # ------------------------------------------------------------- lifecycle
@@ -159,6 +179,18 @@ class IntrospectionServer:
         if self._clock_thread is not None:
             self._clock_thread.join(timeout=5)
             self._clock_thread = None
+        # Read under the profile lock: an in-flight /profilez handler
+        # (handler threads are not joined by httpd.shutdown) may be
+        # arming a capture concurrently -- the lock orders us after its
+        # spawn, and the handler's own stopped-check (below) orders any
+        # LATER arm after our _stop.set(). Either way no capture thread
+        # survives stop().
+        with self._profile_lock:
+            profile_thread, self._profile_thread = self._profile_thread, None
+        if profile_thread is not None:
+            # _stop is set above, so an armed capture's wait() returns
+            # immediately and the profiler context closes before teardown.
+            profile_thread.join(timeout=5)
 
     def __enter__(self) -> "IntrospectionServer":
         return self.start()
@@ -223,6 +255,16 @@ class IntrospectionServer:
     def _route_tracez(self, query: Dict[str, List[str]]):
         self.requests += 1
         limit = _limit(query)
+        if query.get("format", [None])[0] == "chrome":
+            from .trace_export import chrome_trace
+
+            matches: List[Dict[str, Any]] = []
+            if self.match_exemplars is not None:
+                matches = self.match_exemplars(limit)
+            doc = chrome_trace(
+                tracer=self.tracer, match_exemplars=matches, limit=limit
+            )
+            return "application/json", json.dumps(doc).encode("utf-8")
         kind = query.get("kind", ["span"])[0]
         if kind == "match":
             matches: List[Dict[str, Any]] = []
@@ -235,4 +277,44 @@ class IntrospectionServer:
                 "kind": "span",
                 "spans": self.tracer.recent(limit, name=name),
             }
+        return "application/json", json.dumps(body).encode("utf-8")
+
+    def _route_profilez(self, query: Dict[str, List[str]]):
+        """Arm an on-demand device xplane capture for ?secs=N (clamped)
+        on a daemon thread, so the running pipeline profiles itself
+        without a profiler attach. The capture wall also lands as a
+        `device_trace` span (SpanTracer.device), so /tracez shows when a
+        profile was taken. One capture at a time: a second request while
+        armed replies busy instead of stacking profiler sessions."""
+        self.requests += 1
+        try:
+            secs = float(query.get("secs", ["1"])[0])
+        except (TypeError, ValueError):
+            secs = 1.0
+        secs = max(0.0, min(secs, self.PROFILE_MAX_SECS))
+        with self._profile_lock:
+            if self._stop.is_set():
+                # stop() already began: never arm a capture that would
+                # outlive the plane (stop() joins under this same lock).
+                body = {"armed": False, "stopping": True}
+                return "application/json", json.dumps(body).encode("utf-8")
+            if self._profile_thread is not None and self._profile_thread.is_alive():
+                body = {"armed": False, "busy": True}
+                return "application/json", json.dumps(body).encode("utf-8")
+            log_dir = self.profile_dir
+            if log_dir is None:
+                import tempfile
+
+                log_dir = tempfile.mkdtemp(prefix="cep-profilez-")
+
+            def _capture() -> None:
+                with self.tracer.device(log_dir):
+                    self._stop.wait(secs)
+
+            self._profile_thread = threading.Thread(
+                target=_capture, name="kct-introspect-profile", daemon=True
+            )
+            self.profile_captures += 1
+            self._profile_thread.start()
+        body = {"armed": True, "secs": secs, "log_dir": log_dir}
         return "application/json", json.dumps(body).encode("utf-8")

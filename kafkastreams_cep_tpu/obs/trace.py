@@ -81,9 +81,12 @@ class SpanTracer:
     @contextlib.contextmanager
     def device(self, log_dir: str, name: str = "device_trace") -> Iterator[Any]:
         """Capture a device xplane profile of the block AND record its wall
-        as a span (the existing ops.profiling.device_trace, wrapped)."""
+        as a span (the existing ops.profiling.device_trace, wrapped).
+        An unavailable profiler degrades to the bare span, with the
+        condition persisted on this tracer's registry
+        (`cep_profiler_unavailable{reason}`)."""
         from ..ops.profiling import device_trace
 
         with self.span(name):
-            with device_trace(log_dir):
+            with device_trace(log_dir, registry=self.registry):
                 yield

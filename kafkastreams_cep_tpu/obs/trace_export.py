@@ -1,0 +1,155 @@
+"""Timeline export: SpanTracer rings + match exemplars as Chrome-trace JSON.
+
+The SpanTracer's recent-span ring (restore / poll-commit / device_trace
+walls) and the engines' sampled match-provenance exemplars could only be
+read as JSON lists until ISSUE 9 -- no timeline view. This module renders
+both into the Chrome Trace Event format (the JSON Perfetto and
+chrome://tracing load natively), so "what did this process just spend
+time on" becomes a zoomable timeline instead of a scrollback of dicts:
+
+- **Host spans** become complete (``"ph": "X"``) events on the wall-clock
+  timebase: ``ts`` is the span's start in microseconds since the Unix
+  epoch, ``dur`` its wall duration. One timeline row per span name (the
+  ``tid`` is a stable small index per name) so poll/commit/restore
+  cadence reads at a glance.
+- **Match exemplars** become complete events on the EVENT-TIME timebase
+  (the window's first..last event timestamp): a match's provenance
+  carries no host wall stamp, so mixing it into the span rows would lie
+  about simultaneity. They land under their own process row
+  (``pid`` MATCH_PID, one row per query) with the full provenance dict
+  in ``args`` -- clicking a match in Perfetto shows its lineage.
+
+`chrome_trace` returns the JSON-object flavor (``{"traceEvents": [...]}``
+plus metadata); the event array alone is also a valid trace. Serving
+lives in obs/http.py (``/tracez?format=chrome``); bench.py can write the
+same document to disk (``--trace-out``).
+
+Everything here is a pure host-side read of already-recorded rings --
+rendering a timeline can never sync the device or touch the data path.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Mapping, Optional
+
+from .trace import SpanTracer
+
+__all__ = [
+    "MATCH_PID",
+    "SPAN_PID",
+    "chrome_trace",
+    "match_events",
+    "span_events",
+    "write_chrome_trace",
+]
+
+#: Chrome-trace process ids: host wall spans vs event-time match rows.
+#: Two timebases must never share a row (see module docstring).
+SPAN_PID = 1
+MATCH_PID = 2
+
+
+def span_events(
+    spans: Iterable[Mapping[str, Any]],
+    pid: int = SPAN_PID,
+) -> List[Dict[str, Any]]:
+    """Render SpanTracer ring entries (``recent()`` dicts: span /
+    end_unix / duration_s) as Chrome complete events, one ``tid`` row per
+    span name. Input order is free; output carries whatever was given
+    (trace viewers sort by ``ts`` themselves)."""
+    rows: Dict[str, int] = {}
+    out: List[Dict[str, Any]] = []
+    for s in spans:
+        name = str(s.get("span", "span"))
+        tid = rows.setdefault(name, len(rows) + 1)
+        dur_s = float(s.get("duration_s", 0.0))
+        end_unix = float(s.get("end_unix", 0.0))
+        out.append(
+            {
+                "name": name,
+                "cat": "host_span",
+                "ph": "X",
+                # Microseconds since the epoch: Perfetto renders absolute
+                # wall clocks fine, and two exports from two processes
+                # line up without a shared t0 handshake.
+                "ts": (end_unix - dur_s) * 1e6,
+                "dur": dur_s * 1e6,
+                "pid": pid,
+                "tid": tid,
+                "args": {"end_unix": end_unix},
+            }
+        )
+    return out
+
+
+def match_events(
+    matches: Iterable[Mapping[str, Any]],
+    pid: int = MATCH_PID,
+) -> List[Dict[str, Any]]:
+    """Render match-provenance exemplars (provenance_exemplars() dicts)
+    as Chrome complete events on the event-time axis: ts..ts+dur is the
+    match window's first..last event timestamp (ms -> us), with the full
+    provenance in ``args``. Zero-width windows (single-event matches)
+    still render: viewers draw a minimal sliver for dur=0."""
+    rows: Dict[str, int] = {}
+    out: List[Dict[str, Any]] = []
+    for m in matches:
+        query = str(m.get("query", "q"))
+        tid = rows.setdefault(query, len(rows) + 1)
+        t0_ms = float(m.get("first_timestamp", -1))
+        t1_ms = float(m.get("last_timestamp", t0_ms))
+        out.append(
+            {
+                "name": query,
+                "cat": "match_event_time",
+                "ph": "X",
+                "ts": t0_ms * 1e3,
+                "dur": max(t1_ms - t0_ms, 0.0) * 1e3,
+                "pid": pid,
+                "tid": tid,
+                "args": dict(m),
+            }
+        )
+    return out
+
+
+def _process_metadata(pid: int, name: str) -> Dict[str, Any]:
+    return {
+        "name": "process_name",
+        "ph": "M",
+        "pid": pid,
+        "tid": 0,
+        "args": {"name": name},
+    }
+
+
+def chrome_trace(
+    tracer: Optional[SpanTracer] = None,
+    spans: Optional[Iterable[Mapping[str, Any]]] = None,
+    match_exemplars: Optional[Iterable[Mapping[str, Any]]] = None,
+    limit: int = 1024,
+) -> Dict[str, Any]:
+    """The full Chrome-trace document: host spans (from `tracer.recent`
+    or an explicit `spans` iterable) + optional match exemplars, with
+    process-name metadata rows naming the two timebases."""
+    if spans is None:
+        spans = tracer.recent(limit) if tracer is not None else []
+    events: List[Dict[str, Any]] = [
+        _process_metadata(SPAN_PID, "host spans (wall clock)"),
+    ]
+    events.extend(span_events(spans))
+    if match_exemplars is not None:
+        events.append(_process_metadata(MATCH_PID, "matches (event time)"))
+        events.extend(match_events(match_exemplars))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"exporter": "kafkastreams_cep_tpu.obs.trace_export"},
+    }
+
+
+def write_chrome_trace(path: str, doc: Mapping[str, Any]) -> None:
+    """Write a chrome_trace() document to disk (load it in Perfetto via
+    "Open trace file" or chrome://tracing)."""
+    with open(path, "w") as f:
+        json.dump(doc, f)

@@ -256,9 +256,43 @@ class BatchTimings:
 
 
 @contextlib.contextmanager
-def device_trace(log_dir: str):
-    """Capture a device profile (xplane) of the enclosed block."""
-    import jax
+def device_trace(log_dir: str, registry: Optional[MetricsRegistry] = None):
+    """Capture a device profile (xplane) of the enclosed block.
 
-    with jax.profiler.trace(log_dir):
+    Degrades to a NO-OP when the jax.profiler capture is unavailable
+    (no TPU runtime, missing tensorboard plugin, a profiler session
+    already active): the enclosed block still runs, and the condition
+    stays visible as the persistent `cep_profiler_unavailable{reason}`
+    gauge on `registry` (process default when omitted) -- an on-demand
+    /profilez request must never crash or wedge the serving process."""
+    from ..obs.registry import default_registry
+
+    def _unavailable(exc: BaseException) -> None:
+        reg = registry if registry is not None else default_registry()
+        reg.gauge(
+            "cep_profiler_unavailable",
+            "1 once a device-trace capture failed to start or finalize "
+            "(profiler missing/busy); persists for the process lifetime",
+            labels=("reason",),
+        ).labels(reason=str(exc)[:120] or type(exc).__name__).set(1)
+
+    try:
+        import jax
+
+        ctx = jax.profiler.trace(log_dir)
+        ctx.__enter__()
+    except Exception as exc:
+        _unavailable(exc)
         yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            ctx.__exit__(None, None, None)
+        except Exception as exc:
+            # Finalization failures (xplane serialization needs pieces the
+            # capture start does not) degrade the same way; swallowed so
+            # they can neither fail the block nor mask an exception
+            # already unwinding it.
+            _unavailable(exc)

@@ -98,6 +98,9 @@ class BatchedDeviceNFA:
         drain_mode: str = "flat",
         target_emit_ms: Optional[float] = None,
         profile_sync: bool = False,
+        profile_every: Optional[int] = None,
+        compile_telemetry: bool = True,
+        compile_cost_estimates: bool = False,
         registry: Optional[Any] = None,
         provenance_sample: float = 0.0,
         provenance_ring: int = 256,
@@ -181,6 +184,21 @@ class BatchedDeviceNFA:
         #: the flush amortization the smoke sweep pins). Disables the
         #: zero-sync pipeline -- bench/CI use only.
         self.profile_sync = profile_sync
+        #: Sampled always-on phase timing (ISSUE 9): every N-th advance
+        #: runs the same synced compute-wall breakdown and feeds the
+        #: `cep_advance_compute_seconds{phase}` histograms, so production
+        #: runs see kernel-time drift at ~1/N of the sync cost while the
+        #: other N-1 advances keep the zero-sync pipeline (pinned by
+        #: tests/test_profiling.py against the same sync detector as the
+        #: zero-sync contract). profile_sync=True is profile_every=1 with
+        #: legacy spelling (both feed the histograms).
+        if profile_every is not None and int(profile_every) < 1:
+            raise ValueError(
+                f"profile_every must be >= 1, got {profile_every}"
+            )
+        self.profile_every = (
+            None if profile_every is None else int(profile_every)
+        )
         import time as _time
 
         self._last_pull_t = _time.perf_counter()
@@ -313,6 +331,36 @@ class BatchedDeviceNFA:
 
         self._prov_lock = _threading.Lock()
         self._init_metrics()
+        #: Compile-cost telemetry (ISSUE 9, obs/compile.py): every jitted
+        #: entry point is wrapped so a new shape signature -- an XLA
+        #: compile -- lands in cep_compiles_total{fn} / cep_compile_
+        #: seconds{fn} with cost_analysis() FLOPs/bytes estimates.
+        #: Recompile storms (flatten-bucket churn, key-growth retraces)
+        #: become first-class signals instead of generic slowness. Warm
+        #: calls pay one host-side signature probe (tree_flatten + a
+        #: lock-free dict read): the zero-sync advance pin runs with the
+        #: shim armed. `compile_cost_estimates` additionally
+        #: runs a cost_analysis() lowering per new signature -- a full
+        #: RETRACE of the program, roughly doubling trace time -- so it
+        #: is opt-in (bench arms it; the test suite must not pay it).
+        self.compile_watch = None
+        if compile_telemetry:
+            from ..obs.compile import CompileWatch
+
+            self.compile_watch = CompileWatch(
+                self.metrics, estimate_cost=compile_cost_estimates
+            )
+        self._advance = self._wrap_compiled(self._advance, "advance")
+        self._append = self._wrap_compiled(self._append, "append")
+        self._flush = self._wrap_compiled(self._flush, "flush")
+        self._drain_pend = self._wrap_compiled(self._drain_pend, "drain_pend")
+
+    def _wrap_compiled(self, fn: Any, name: str) -> Any:
+        """Route one jitted entry point through the compile watch (the
+        identity when compile telemetry is off)."""
+        if self.compile_watch is None:
+            return fn
+        return self.compile_watch.wrap(fn, name)
 
     def _init_metrics(self) -> None:
         """Register the engine-level instruments on `self.metrics`.
@@ -410,6 +458,14 @@ class BatchedDeviceNFA:
             "Decoded matches that received a sampled lineage exemplar",
             labels=("query",),
         ).labels(query=self.query_name or "q")
+        compute = r.histogram(
+            "cep_advance_compute_seconds",
+            "Synced compute wall of sampled advances by phase "
+            "(profile_sync or every profile_every-th advance)",
+            labels=("instance", "phase"),
+        )
+        self._m_compute_advance = compute.labels(instance=inst, phase="advance")
+        self._m_compute_post = compute.labels(instance=inst, phase="post")
 
     def _pick_engine(self, engine: str) -> Tuple[str, Optional[str]]:
         """Resolve "auto" to the fused pallas kernel when it applies.
@@ -829,6 +885,25 @@ class BatchedDeviceNFA:
                 self._interval_packs.append(entry)
         import time as _time
 
+        # Sampled phase profiling (ISSUE 9): profile_sync blocks every
+        # advance (the CPU-contract/bench mode); profile_every=N blocks
+        # only every N-th, so the other N-1 advances keep the zero-sync
+        # pipeline while the compute-wall histograms still fill.
+        sync_profile = self.profile_sync or (
+            self.profile_every is not None
+            and self._batches % self.profile_every == 0
+        )
+        # Compile-wall guard for the compute histograms: a sampled advance
+        # that TRACED+COMPILED (first call, shape retrace, fallback
+        # rebuild) would record a wall orders of magnitude above steady
+        # state and permanently skew the drift signal -- the compile watch
+        # already owns that number (cep_compile_seconds), so the phase
+        # histogram skips any advance during which a new signature landed.
+        seen_before = (
+            self.compile_watch.seen_count
+            if (sync_profile and self.compile_watch is not None)
+            else None
+        )
         t0 = _time.perf_counter()
         try:
             if _flt.ACTIVE is None:
@@ -883,11 +958,17 @@ class BatchedDeviceNFA:
                 instance=self.instance_id,
                 engine=self.engine, drain_mode=self.drain_mode,
             ).set(1)
-            self._advance = build_batched_advance(self.query, self.config)
-            self._append = build_batched_append(self.config)
-            self._flush = build_batched_flush(self.query, self.config)
+            self._advance = self._wrap_compiled(
+                build_batched_advance(self.query, self.config), "advance"
+            )
+            self._append = self._wrap_compiled(
+                build_batched_append(self.config), "append"
+            )
+            self._flush = self._wrap_compiled(
+                build_batched_flush(self.query, self.config), "flush"
+            )
             self.state, ys = self._advance(self.state, xs)
-        if self.profile_sync:
+        if sync_profile:
             jax.block_until_ready(ys)
         t_adv = _time.perf_counter()
         # Per-advance light post: pend append (capacity guards keep
@@ -904,8 +985,18 @@ class BatchedDeviceNFA:
         # Host-side group phase (== the device gc_phase scalar by
         # construction): no pull needed.
         self._m_gc_phase.set(len(self._group_ys))
-        if self.profile_sync:
+        if sync_profile:
             jax.block_until_ready((self.state, self.pool))
+            # Both blocks landed: these are COMPUTE walls, not dispatch
+            # walls -- the kernel-time drift signal. Skipped when this
+            # advance compiled anything (see seen_before above; without a
+            # compile watch the caller keeps the raw profile_sync walls).
+            if (
+                seen_before is None
+                or self.compile_watch.seen_count == seen_before
+            ):
+                self._m_compute_advance.observe(t_adv - t0)
+                self._m_compute_post.observe(_time.perf_counter() - t_adv)
         self._batches += 1
         self._pend_accum += step_cap
         if self.auto_drain and step_cap <= self.config.matches:
@@ -1369,8 +1460,11 @@ class BatchedDeviceNFA:
         retention especially -- squeeze the node region; a drain resets
         pend_min so the next GC collects)."""
         if self._pos_max_fn is None:
-            self._pos_max_fn = jax.jit(
-                lambda pos, nc: jnp.stack([jnp.max(pos), jnp.max(nc)])
+            self._pos_max_fn = self._wrap_compiled(
+                jax.jit(
+                    lambda pos, nc: jnp.stack([jnp.max(pos), jnp.max(nc)])
+                ),
+                "pos_probe",
             )
         arr = self._pos_max_fn(self.pool["pend_pos"], self.pool["node_count"])
         try:
@@ -1642,7 +1736,9 @@ class BatchedDeviceNFA:
         if self._drain_probe_fn is None:
             from ..ops.engine import drain_probe
 
-            self._drain_probe_fn = jax.jit(drain_probe)
+            self._drain_probe_fn = self._wrap_compiled(
+                jax.jit(drain_probe), "drain_probe"
+            )
         t0 = _time.perf_counter()
         probe = np.asarray(self._drain_probe_fn(pool_view))  # the one sync
         counts = probe[0]
@@ -1671,7 +1767,11 @@ class BatchedDeviceNFA:
         if fn is None:
             from ..ops.engine import build_chain_flatten
 
-            fn = self._flatten_fns[(Mb, Cb)] = build_chain_flatten(Mb, Cb)
+            # One "flatten" label across every (Mb, Cb) bucket: bucket
+            # churn IS the recompile storm the compile watch must show.
+            fn = self._flatten_fns[(Mb, Cb)] = self._wrap_compiled(
+                build_chain_flatten(Mb, Cb), "flatten"
+            )
         table = fn(pool_view)  # [3, Mb, Cb, K] device-side
         try:
             table.copy_to_host_async()
@@ -1711,8 +1811,11 @@ class BatchedDeviceNFA:
         # One small [2, K] probe decides everything cheap: pending counts
         # and ring cursors.
         if self._drain_counts_fn is None:
-            self._drain_counts_fn = jax.jit(
-                lambda p: jnp.stack([p["pend_count"], p["pend_pos"]])
+            self._drain_counts_fn = self._wrap_compiled(
+                jax.jit(
+                    lambda p: jnp.stack([p["pend_count"], p["pend_pos"]])
+                ),
+                "drain_counts",
             )
         both = np.asarray(self._drain_counts_fn(self.pool))
         counts = both[0]

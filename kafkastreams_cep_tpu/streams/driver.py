@@ -155,6 +155,9 @@ class LogDriver:
         self._last_commit_wall: Optional[float] = None
         #: The attached introspection server, if serve_http() was called.
         self.http = None
+        #: Set once close() ran: the pump refuses further polls and the
+        #: reporter stays quiesced.
+        self._closed = False
         self._positions: Dict[Tuple[str, int], int] = {}
         #: positions as last durably committed -- commit() appends only the
         #: deltas, so the offsets topic grows with progress, not with the
@@ -239,6 +242,8 @@ class LogDriver:
     def poll(self, max_records: Optional[int] = None, commit: bool = True) -> int:
         """Consume available records from every source topic, in offset
         order per partition; returns how many were processed."""
+        if self._closed:
+            raise RuntimeError("LogDriver is closed")
         processed = 0
         budget = max_records
         for topic in self.topology.source_topics:
@@ -462,6 +467,39 @@ class LogDriver:
                 out.extend(fn(limit))
         return out[:limit]
 
+    def close(self, commit: bool = True) -> None:
+        """Orderly shutdown -- the clock-thread race fix (ISSUE 9).
+
+        `disarm_reporter` only quiesces REPORTS; the introspection
+        plane's clock thread keeps running and a tick in flight can call
+        `maybe_report()` -- and through `health_fn` read driver state --
+        while a caller is tearing the pipeline down (the
+        `disarm_reporter` docstring documented the race for
+        `report_every_s = None` only). The fix is ordering: stop the
+        HTTP plane FIRST (`IntrospectionServer.stop` joins both the
+        serve and clock threads), so by the time anything else is torn
+        down no tick can be in flight; then disarm the reporter and take
+        a final commit so processed-but-uncommitted positions survive.
+        Idempotent; `poll()` after close raises. Pinned by
+        tests/test_introspection.py."""
+        if self._closed:
+            return
+        if self.http is not None:
+            self.http.stop()
+            self.http = None
+        self.disarm_reporter()
+        # Only now is it safe to mark closed and touch shared state: no
+        # clock tick can race the final flush/commit.
+        self._closed = True
+        if commit:
+            self.commit()
+
+    def __enter__(self) -> "LogDriver":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
     def serve_http(
         self,
         host: str = "127.0.0.1",
@@ -477,6 +515,8 @@ class LogDriver:
         kept on `self.http`); `port=0` binds an ephemeral port."""
         from ..obs.http import IntrospectionServer
 
+        if self._closed:
+            raise RuntimeError("LogDriver is closed")
         if tick_every_s is None:
             tick_every_s = 0.25
             if self.report_every_s is not None:

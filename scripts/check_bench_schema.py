@@ -46,6 +46,8 @@ TOP_LEVEL: Dict[str, Tuple[bool, tuple]] = {
     "latency": (True, (dict, type(None))),
     "observation": (True, (dict,)),
     "metrics_merged": (True, (dict, type(None))),
+    "compile": (True, (dict,)),
+    "regression": (True, (dict, type(None))),
     "schema_ok": (False, (bool,)),
 }
 
@@ -57,6 +59,44 @@ OBSERVATION_KEYS: Dict[str, tuple] = {
     "http_server": (bool,),
     "http_endpoints_ok": (bool, type(None)),
     "served_matches_snapshot": (bool, type(None)),
+    "chrome_trace_ok": (bool, type(None)),
+    "profilez_armed": (bool, type(None)),
+}
+
+#: The `compile` block (ISSUE 9): per-entry-point compile telemetry from
+#: the flagship engine's compile watch (obs/compile.py). `fns` entries
+#: carry compiles/seconds always; flops/bytes are None when the backend
+#: offers no cost model for that lowering.
+COMPILE_KEYS: Dict[str, tuple] = {
+    "fns": (dict,),
+    "total_compiles": NUMBER,
+    "total_seconds": NUMBER,
+}
+COMPILE_FN_KEYS: Dict[str, tuple] = {
+    "compiles": NUMBER,
+    "seconds": NUMBER,
+    "flops": OPT_NUMBER,
+    "bytes": OPT_NUMBER,
+}
+
+#: The `regression` block (ISSUE 9): deltas vs a --compare prior
+#: artifact; None without --compare. Per-config entries hold per-metric
+#: {prev, cur, delta_pct, regressed} dicts.
+REGRESSION_KEYS: Dict[str, tuple] = {
+    "prior": (str,),
+    "tolerance": NUMBER,
+    "configs": (dict,),
+    "missing_configs": (list,),
+    "regressed": (bool,),
+    "excused": (bool,),
+    "tunnel_degraded_prev": (bool,),
+    "tunnel_degraded_cur": (bool,),
+}
+REGRESSION_METRIC_KEYS: Dict[str, tuple] = {
+    "prev": NUMBER,
+    "cur": NUMBER,
+    "delta_pct": OPT_NUMBER,
+    "regressed": (bool,),
 }
 
 #: The `latency` block (ISSUE 7): the end-to-end match-latency histogram
@@ -265,6 +305,33 @@ def validate(out: Any) -> List[str]:
         )
     if isinstance(out.get("latency"), (dict, type(None))):
         _check_flat_block(out.get("latency"), LATENCY_KEYS, "latency", errors)
+    compile_block = out.get("compile")
+    if isinstance(compile_block, dict):
+        _check_flat_block(compile_block, COMPILE_KEYS, "compile", errors)
+        for fn, entry in (compile_block.get("fns") or {}).items():
+            if not isinstance(entry, dict):
+                errors.append(f"compile.fns.{fn}: expected object")
+            else:
+                _check_flat_block(
+                    entry, COMPILE_FN_KEYS, f"compile.fns.{fn}", errors
+                )
+    regression = out.get("regression")
+    if isinstance(regression, dict):
+        _check_flat_block(regression, REGRESSION_KEYS, "regression", errors)
+        for name, entry in (regression.get("configs") or {}).items():
+            if not isinstance(entry, dict):
+                errors.append(f"regression.configs.{name}: expected object")
+                continue
+            for metric, d in entry.items():
+                if not isinstance(d, dict):
+                    errors.append(
+                        f"regression.configs.{name}.{metric}: expected object"
+                    )
+                else:
+                    _check_flat_block(
+                        d, REGRESSION_METRIC_KEYS,
+                        f"regression.configs.{name}.{metric}", errors,
+                    )
     faults = out.get("faults")
     if isinstance(faults, dict):
         for k in FAULT_KEYS:

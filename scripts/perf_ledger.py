@@ -1,0 +1,516 @@
+#!/usr/bin/env python
+"""Perf ledger: machine-checked throughput trajectory over BENCH_r*.json.
+
+Five bench rounds sat on disk with no automated comparison (ISSUE 9): a
+regression had to be eyeballed out of raw JSON, and round 5's tunnel-
+degraded artifact famously read as a 12x drain regression until a human
+diagnosed the environment. This tool turns any set of bench artifacts
+into one trajectory table plus a regression verdict:
+
+- **Ingestion** accepts every shape a round has actually shipped in:
+  the raw one-line bench.py artifact, the driver wrapper
+  ``{"n", "cmd", "rc", "tail", "parsed"}`` (with ``parsed`` preferred
+  when present), and -- because wrappers truncate ``tail`` to its last
+  N characters -- a *salvage* pass that recovers every complete
+  per-config JSON object still visible in a truncated tail.
+- **Trajectory**: per config x round, e2e_eps / engine-only eps /
+  p99 match-emit / tunnel_mbps, plus the per-component breakdown where
+  the artifact carries one.
+- **Regression check**: eps / e2e_eps deltas vs the previous round that
+  has the config (and vs ``--baseline`` when it carries numbers); a drop
+  beyond ``--tolerance`` (default 15%) flags the (config, metric) --
+  EXCEPT when either side of the comparison is marked
+  ``tunnel_degraded`` (environment noise must not fail the check; the
+  row is reported as excused instead).
+
+Usage:
+    python scripts/perf_ledger.py BENCH_r*.json
+    python scripts/perf_ledger.py --tolerance 0.10 --json BENCH_r0[45].json
+    python scripts/perf_ledger.py --baseline BASELINE.json BENCH_r*.json
+
+Exit status: 1 when an unexcused regression was flagged, else 0.
+bench.py reuses `compare_artifacts` for its ``--compare`` mode (the
+artifact's ``regression`` block, validated by check_bench_schema.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Per-config series the trajectory tracks (when present). host_eps /
+#: serde_eps / device_eps read the host-suite configs' nested blocks
+#: ({"host": {...}, "device_single": {...}}) so letters_strict-style
+#: rounds appear in the table too; they stay context columns, never
+#: regression flags (the host oracle is a CPython denominator whose
+#: wall is environment noise, PERF.md "Denominator").
+TRACKED_METRICS = (
+    "eps", "e2e_eps", "p99_match_emit_ms", "tunnel_mbps",
+    "host_eps", "serde_eps", "device_eps",
+)
+
+#: Nested paths behind the derived metric names above.
+_NESTED_METRICS = {
+    "host_eps": ("host", "eps"),
+    "serde_eps": ("host", "serde_eps"),
+    "device_eps": ("device_single", "eps"),
+}
+
+#: Metrics whose DROP constitutes a regression (latency/tunnel context
+#: columns ride along but do not flag).
+REGRESSION_METRICS = ("eps", "e2e_eps")
+
+#: Salvage whitelist: top-level config names bench.py has ever emitted.
+#: A truncated tail also exposes inner dicts ("host", "device_single",
+#: per-config "components"); only names listed here -- or matching
+#: KNOWN_CONFIG_RE -- are claimed as configs.
+KNOWN_CONFIGS = {
+    "letters_strict",
+    "stock_rising",
+    "skip_any8",
+    "highcard",
+    "skip_any8_batched",
+    "highcard_letters_batched",
+    "stock_rising_batched",
+    "skip_any8_latency",
+    "skip_any8_latency_microdrain",
+    "multi_query",
+    "introspection",
+}
+KNOWN_CONFIG_RE = re.compile(r"_(batched|latency|query)\w*$")
+
+
+# ----------------------------------------------------------------- ingestion
+def _scan_object(text: str, start: int) -> Optional[str]:
+    """The balanced ``{...}`` substring starting at `start`, honoring JSON
+    strings/escapes; None when the object is truncated."""
+    depth = 0
+    in_str = False
+    esc = False
+    for i in range(start, len(text)):
+        ch = text[i]
+        if in_str:
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = False
+            continue
+        if ch == '"':
+            in_str = True
+        elif ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start: i + 1]
+    return None
+
+
+_CONFIG_KEY_RE = re.compile(r'"([A-Za-z_][A-Za-z0-9_]*)":\s*\{')
+
+
+def salvage_configs(tail: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Recover (configs, top-level scalars) from a truncated artifact tail.
+
+    Walks every ``"name": {`` occurrence left to right, parsing balanced
+    objects; an occurrence inside an already-claimed span is skipped, so
+    a complete config claims its inner "host"/"components" dicts rather
+    than leaking them as configs. Names outside the config whitelist are
+    ignored. Top-level scalars (tunnel_degraded, tunnel_mbps, value) are
+    regexed separately -- they may or may not survive the truncation.
+    """
+    configs: Dict[str, Any] = {}
+    claimed_until = -1
+    for m in _CONFIG_KEY_RE.finditer(tail):
+        if m.start() < claimed_until:
+            continue
+        name = m.group(1)
+        if name not in KNOWN_CONFIGS and not KNOWN_CONFIG_RE.search(name):
+            continue
+        obj_text = _scan_object(tail, m.end() - 1)
+        if obj_text is None:
+            continue  # truncated mid-object
+        try:
+            obj = json.loads(obj_text)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(obj, dict):
+            continue
+        configs[name] = obj
+        claimed_until = m.end() - 1 + len(obj_text)
+    top: Dict[str, Any] = {}
+    m = re.search(r'"tunnel_degraded":\s*(true|false)', tail)
+    if m is not None:
+        top["tunnel_degraded"] = m.group(1) == "true"
+    m = re.search(r'"tunnel_mbps":\s*(null|[0-9.eE+-]+)', tail)
+    if m is not None:
+        top["tunnel_mbps"] = (
+            None if m.group(1) == "null" else float(m.group(1))
+        )
+    return configs, top
+
+
+def parse_artifact(doc: Any) -> Dict[str, Any]:
+    """Normalize one loaded JSON document into a round record:
+    ``{"configs": {...}, "tunnel_degraded": bool|None, "salvaged": bool,
+    "empty": bool}``. Accepts the raw bench.py artifact, the driver
+    wrapper (parsed preferred, tail salvaged), and anything else as an
+    empty round."""
+    if isinstance(doc, dict) and isinstance(doc.get("configs"), dict):
+        return {
+            "configs": doc["configs"],
+            "tunnel_degraded": doc.get("tunnel_degraded"),
+            "salvaged": False,
+            "empty": not doc["configs"],
+        }
+    if isinstance(doc, dict) and ("tail" in doc or "parsed" in doc):
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and isinstance(parsed.get("configs"), dict):
+            return {
+                "configs": parsed["configs"],
+                "tunnel_degraded": parsed.get("tunnel_degraded"),
+                "salvaged": False,
+                "empty": not parsed["configs"],
+            }
+        tail = doc.get("tail") or ""
+        configs, top = salvage_configs(tail)
+        return {
+            "configs": configs,
+            "tunnel_degraded": top.get("tunnel_degraded"),
+            "salvaged": bool(configs),
+            "empty": not configs,
+        }
+    return {"configs": {}, "tunnel_degraded": None, "salvaged": False,
+            "empty": True}
+
+
+def load_artifact(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        text = f.read()
+    # A captured log may hold stderr noise around the one JSON line: take
+    # the last line that parses (same rule as check_bench_schema).
+    doc = None
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        for line in reversed([ln for ln in text.splitlines() if ln.strip()]):
+            try:
+                doc = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    rec = parse_artifact(doc)
+    rec["path"] = path
+    rec["round"] = os.path.splitext(os.path.basename(path))[0]
+    return rec
+
+
+# ---------------------------------------------------------------- trajectory
+def _metric(cfg: Dict[str, Any], name: str) -> Optional[float]:
+    v: Any = cfg
+    for part in _NESTED_METRICS.get(name, (name,)):
+        if not isinstance(v, dict):
+            return None
+        v = v.get(part)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def build_ledger(rounds: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The trajectory: per config, the round-by-round tracked metrics
+    (None where a round lacks the config or the metric)."""
+    configs: List[str] = []
+    for rec in rounds:
+        for name in rec["configs"]:
+            if name not in configs:
+                configs.append(name)
+    table: Dict[str, Dict[str, List[Optional[float]]]] = {}
+    for name in configs:
+        table[name] = {
+            metric: [
+                _metric(rec["configs"].get(name) or {}, metric)
+                for rec in rounds
+            ]
+            for metric in TRACKED_METRICS
+        }
+    return {
+        "rounds": [
+            {
+                "round": rec["round"],
+                "path": rec.get("path"),
+                "tunnel_degraded": rec["tunnel_degraded"],
+                "salvaged": rec["salvaged"],
+                "empty": rec["empty"],
+                "n_configs": len(rec["configs"]),
+            }
+            for rec in rounds
+        ],
+        "configs": configs,
+        "table": table,
+    }
+
+
+def delta_pct(prev: float, cur: float) -> Optional[float]:
+    if prev == 0:
+        return None
+    return (cur - prev) / prev * 100.0
+
+
+def find_regressions(
+    ledger: Dict[str, Any],
+    rounds: List[Dict[str, Any]],
+    tolerance: float = 0.15,
+) -> List[Dict[str, Any]]:
+    """Flag (config, metric, round) drops beyond `tolerance` vs the
+    previous round carrying the metric. Entries where either side's
+    round is tunnel_degraded come back with ``"excused": True`` --
+    reported, never failed on."""
+    out: List[Dict[str, Any]] = []
+    degraded = [bool(rec["tunnel_degraded"]) for rec in rounds]
+    names = [rec["round"] for rec in rounds]
+    for config, series in ledger["table"].items():
+        for metric in REGRESSION_METRICS:
+            vals = series[metric]
+            prev_i: Optional[int] = None
+            for i, v in enumerate(vals):
+                if v is None:
+                    continue
+                if prev_i is not None:
+                    prev = vals[prev_i]
+                    dp = delta_pct(prev, v)
+                    if dp is not None and dp <= -tolerance * 100.0:
+                        out.append(
+                            {
+                                "config": config,
+                                "metric": metric,
+                                "round": names[i],
+                                "prev_round": names[prev_i],
+                                "prev": prev,
+                                "cur": v,
+                                "delta_pct": dp,
+                                "excused": degraded[i] or degraded[prev_i],
+                            }
+                        )
+                prev_i = i
+    return out
+
+
+# ------------------------------------------------------- artifact comparison
+def compare_artifacts(
+    prev: Dict[str, Any],
+    cur: Dict[str, Any],
+    tolerance: float = 0.15,
+    prior_name: str = "prior",
+) -> Dict[str, Any]:
+    """The ``regression`` block bench.py --compare embeds: per shared
+    config, prev/cur/delta for each regression metric, with the overall
+    verdict and the tunnel-degraded excusal. `prev`/`cur` are normalized
+    round records (parse_artifact output) or raw artifacts."""
+    if "configs" not in prev or not isinstance(prev.get("configs"), dict):
+        prev = parse_artifact(prev)
+    if "configs" not in cur or not isinstance(cur.get("configs"), dict):
+        cur = parse_artifact(cur)
+    deg_prev = bool(prev.get("tunnel_degraded"))
+    deg_cur = bool(cur.get("tunnel_degraded"))
+    excused = deg_prev or deg_cur
+    per_config: Dict[str, Any] = {}
+    regressed = False
+    # A config the prior carried that the current run LACKS is reported,
+    # not silently passed (a vanished flagship benchmark is worse than
+    # any delta) -- but it does not flag `regressed`: subset runs
+    # (--configs, --smoke) legitimately compare against fuller priors.
+    missing = sorted(
+        name
+        for name, prev_cfg in prev["configs"].items()
+        if isinstance(prev_cfg, dict)
+        and any(_metric(prev_cfg, m) is not None for m in REGRESSION_METRICS)
+        and name not in cur["configs"]
+    )
+    for name, cur_cfg in cur["configs"].items():
+        prev_cfg = prev["configs"].get(name)
+        if not isinstance(prev_cfg, dict) or not isinstance(cur_cfg, dict):
+            continue
+        entry: Dict[str, Any] = {}
+        for metric in REGRESSION_METRICS:
+            p = _metric(prev_cfg, metric)
+            c = _metric(cur_cfg, metric)
+            if p is None or c is None:
+                continue
+            dp = delta_pct(p, c)
+            flag = dp is not None and dp <= -tolerance * 100.0
+            entry[metric] = {
+                "prev": p,
+                "cur": c,
+                "delta_pct": dp,
+                "regressed": flag,
+            }
+            regressed = regressed or flag
+        if entry:
+            per_config[name] = entry
+    return {
+        "prior": prior_name,
+        "tolerance": tolerance,
+        "configs": per_config,
+        "missing_configs": missing,
+        "regressed": regressed,
+        "excused": excused and regressed,
+        "tunnel_degraded_prev": deg_prev,
+        "tunnel_degraded_cur": deg_cur,
+    }
+
+
+# ------------------------------------------------------------------ rendering
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if abs(v) >= 1000:
+        return f"{v:,.0f}"
+    return f"{v:.1f}"
+
+
+def render_table(
+    ledger: Dict[str, Any],
+    rounds: List[Dict[str, Any]],
+    regressions: List[Dict[str, Any]],
+) -> str:
+    """The human trajectory table: one section per config, one row per
+    tracked metric, one column per round; flagged cells carry ``!``
+    (regression) or ``~`` (excused by tunnel degradation)."""
+    names = [rec["round"] for rec in rounds]
+    flags = {
+        (r["config"], r["metric"], r["round"]): r for r in regressions
+    }
+    width = max([len(n) for n in names] + [12])
+    lines: List[str] = []
+    header = f"{'config / metric':<34}" + "".join(
+        f"{n:>{width + 2}}" for n in names
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for config in ledger["configs"]:
+        lines.append(config)
+        for metric in TRACKED_METRICS:
+            vals = ledger["table"][config][metric]
+            if all(v is None for v in vals):
+                continue
+            cells = []
+            for name, v in zip(names, vals):
+                cell = _fmt(v)
+                flag = flags.get((config, metric, name))
+                if flag is not None:
+                    cell += "~" if flag["excused"] else "!"
+                cells.append(f"{cell:>{width + 2}}")
+            lines.append(f"  {metric:<32}" + "".join(cells))
+    notes = []
+    for rec in rounds:
+        tags = []
+        if rec["empty"]:
+            tags.append("no data (empty/truncated artifact)")
+        elif rec["salvaged"]:
+            tags.append("salvaged from truncated tail")
+        if rec["tunnel_degraded"]:
+            tags.append("tunnel_degraded")
+        if tags:
+            notes.append(f"  {rec['round']}: {', '.join(tags)}")
+    if notes:
+        lines.append("")
+        lines.append("round notes:")
+        lines.extend(notes)
+    flagged = [r for r in regressions if not r["excused"]]
+    excused = [r for r in regressions if r["excused"]]
+    lines.append("")
+    if flagged:
+        lines.append(f"REGRESSIONS ({len(flagged)} unexcused):")
+        for r in flagged:
+            lines.append(
+                f"  ! {r['config']}.{r['metric']} {r['prev_round']} -> "
+                f"{r['round']}: {_fmt(r['prev'])} -> {_fmt(r['cur'])} "
+                f"({r['delta_pct']:+.1f}%)"
+            )
+    else:
+        lines.append("no unexcused regressions")
+    for r in excused:
+        lines.append(
+            f"  ~ excused (tunnel_degraded) {r['config']}.{r['metric']} "
+            f"{r['prev_round']} -> {r['round']}: {r['delta_pct']:+.1f}%"
+        )
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------------ CLI
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("artifacts", nargs="+", help="BENCH_r*.json, in order")
+    ap.add_argument(
+        "--baseline", default=None,
+        help="baseline artifact (compared when it carries config numbers; "
+        "the repo's BASELINE.json is descriptive-only and yields n/a)",
+    )
+    ap.add_argument(
+        "--tolerance", type=float, default=0.15,
+        help="fractional eps drop that flags a regression (default 0.15)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the ledger + regressions as JSON instead of the table",
+    )
+    args = ap.parse_args(argv[1:])
+
+    rounds = [load_artifact(p) for p in args.artifacts]
+    ledger = build_ledger(rounds)
+    regressions = find_regressions(ledger, rounds, tolerance=args.tolerance)
+
+    baseline_cmp = None
+    if args.baseline:
+        base = load_artifact(args.baseline)
+        latest = next(
+            (rec for rec in reversed(rounds) if not rec["empty"]), None
+        )
+        if base["empty"]:
+            baseline_cmp = {
+                "note": f"{args.baseline} carries no config numbers; "
+                "baseline deltas n/a"
+            }
+        elif latest is not None:
+            baseline_cmp = compare_artifacts(
+                base, latest, tolerance=args.tolerance,
+                prior_name=args.baseline,
+            )
+
+    if args.json:
+        print(json.dumps(
+            {
+                "ledger": ledger,
+                "regressions": regressions,
+                "baseline": baseline_cmp,
+                "tolerance": args.tolerance,
+            },
+            indent=2,
+        ))
+    else:
+        print(render_table(ledger, rounds, regressions))
+        if baseline_cmp is not None:
+            note = baseline_cmp.get("note")
+            if note:
+                print(f"\nbaseline: {note}")
+            else:
+                print(
+                    f"\nbaseline ({args.baseline}): regressed="
+                    f"{baseline_cmp['regressed']} "
+                    f"excused={baseline_cmp['excused']}"
+                )
+    return 1 if any(not r["excused"] for r in regressions) else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main(sys.argv))
+    except BrokenPipeError:
+        # `perf_ledger.py ... | head` closing the pipe is not an error.
+        os._exit(0)

@@ -55,4 +55,11 @@ def pytest_configure(config):
         "markers",
         "obs: observability-suite tests (fast, deterministic, CPU-safe)",
     )
+    # `profiling` mirrors `obs`/`chaos`: rides tier-1, and
+    # `pytest -m profiling` selects the performance-observability suite
+    # (compile telemetry, sampled phase timing, trace export, perf ledger).
+    config.addinivalue_line(
+        "markers",
+        "profiling: performance-observability tests (fast, CPU-safe)",
+    )
     config.addinivalue_line("markers", "slow: excluded from tier-1")

@@ -521,3 +521,247 @@ def test_device_pipeline_exemplars_surface_user_keys():
         assert ex["first_offset"] == 1 and ex["last_offset"] == 3
     finally:
         srv.stop()
+
+
+# ----------------------------------------------------- merge edge cases
+def test_merge_zero_and_single_registry():
+    """Satellite (ISSUE 9): the degenerate fleet sizes must merge, not
+    crash -- zero registries yield an empty exposition, one registry
+    round-trips its values (gauges still gain the device label: a
+    one-device fleet is a fleet)."""
+    empty = merge_registries({})
+    assert empty.snapshot() == {}
+    assert empty.to_prom_text() == ""
+    assert merge_snapshots({}) == {}
+    one = _device_regs(1)
+    merged = merge_registries(one)
+    snap = merged.snapshot()
+    assert snap["dev_events_total"]["values"][0]["value"] == 10
+    assert snap["dev_fill"]["label_names"] == ["device"]
+    assert snap["dev_fill"]["values"][0]["labels"] == {"device": "0"}
+    hv = snap["dev_wall_seconds"]["values"][0]
+    assert hv["count"] == 2 and hv["buckets"]["+Inf"] == 2
+    # A single EMPTY registry merges to an empty exposition too.
+    assert merge_registries({"0": MetricsRegistry()}).snapshot() == {}
+
+
+def test_merge_disjoint_histogram_layouts_typed_error():
+    """Disjoint layouts raise the typed error (ValueError), whichever
+    device arrives first -- never a corrupt merged family."""
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.histogram("h", buckets=(0.1, 1.0)).observe(0.2)
+    b.histogram("h", buckets=(0.1, 1.0, 10.0)).observe(0.2)
+    with pytest.raises(ValueError, match="bucket"):
+        merge_registries({"0": a, "1": b})
+    with pytest.raises(ValueError, match="bucket"):
+        merge_registries({"0": b, "1": a})
+
+
+# ------------------------------------------------ provenance ring bound
+def test_provenance_exemplar_ring_bounded_at_full_sample_rate():
+    """Satellite (ISSUE 9): provenance_sample=1.0 over many more matches
+    than the ring holds keeps the ring -- and /tracez?kind=match -- at
+    the configured bound, newest-first."""
+    query = compile_query(compile_pattern(letters_pattern()), None)
+    bat = BatchedDeviceNFA(
+        query, keys=["K"],
+        config=EngineConfig(lanes=8, nodes=512, matches=256),
+        provenance_sample=1.0, provenance_ring=8, query_name="q",
+    )
+    stream = []
+    for b in range(24):  # 24 matches >> ring of 8
+        for i, ch in enumerate("ABC"):
+            stream.append(Event("K", ch, TS + 10 * b + i, "t", 0, 10 * b + i))
+    got = [s for seqs in bat.advance({"K": stream}).values() for s in seqs]
+    assert len(got) == 24
+    # Every match was sampled (the counter saw all of them)...
+    snap = bat.metrics.snapshot()
+    sampled = {
+        v["labels"]["query"]: v["value"]
+        for v in snap["cep_provenance_sampled_total"]["values"]
+    }
+    assert sampled["q"] == 24
+    # ...but the ring holds only the newest 8, whatever limit is asked.
+    assert len(bat._prov_ring) == 8
+    ex = bat.provenance_exemplars(10_000)
+    assert len(ex) == 8
+    assert ex[0]["last_offset"] == 232  # newest first (block 23, i=2)
+    ring_served = bat.provenance_exemplars(3)
+    assert len(ring_served) == 3
+
+
+# ------------------------------------------------- chrome trace export
+def test_chrome_trace_export_shapes():
+    from kafkastreams_cep_tpu.obs.trace_export import (
+        MATCH_PID,
+        SPAN_PID,
+        chrome_trace,
+        match_events,
+        span_events,
+    )
+
+    reg = MetricsRegistry()
+    tracer = SpanTracer(reg)
+    with tracer.span("restore"):
+        time.sleep(0.002)
+    with tracer.span("commit"):
+        pass
+    evs = span_events(tracer.recent(16))
+    assert {e["name"] for e in evs} == {"restore", "commit"}
+    for e in evs:
+        assert e["ph"] == "X" and e["pid"] == SPAN_PID
+        assert e["dur"] >= 0 and e["ts"] > 0
+    restore = next(e for e in evs if e["name"] == "restore")
+    assert restore["dur"] >= 2_000  # 2 ms in us
+    # One tid row per span name.
+    assert len({e["tid"] for e in evs}) == 2
+    mevs = match_events([
+        {"query": "q", "first_timestamp": 100, "last_timestamp": 130,
+         "stage_path": ["a"], "key": "K"},
+        {"query": "q2", "first_timestamp": 50, "last_timestamp": 50},
+    ])
+    assert mevs[0]["ts"] == 100_000 and mevs[0]["dur"] == 30_000
+    assert mevs[1]["dur"] == 0  # zero-width window still renders
+    assert mevs[0]["pid"] == MATCH_PID
+    assert mevs[0]["args"]["key"] == "K"
+    doc = chrome_trace(tracer=tracer, match_exemplars=[
+        {"query": "q", "first_timestamp": 1, "last_timestamp": 2},
+    ])
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "process_name" in names and "restore" in names and "q" in names
+    # The document is JSON-serializable as served.
+    json.dumps(doc)
+
+
+def test_tracez_chrome_format_served_and_loadable():
+    """The acceptance contract: /tracez?format=chrome returns a document
+    whose traceEvents loads as a valid Chrome-trace event array."""
+    reg = MetricsRegistry()
+    tracer = SpanTracer(reg)
+    with tracer.span("poll"):
+        pass
+    exemplars = [
+        {"query": "q", "first_timestamp": 10, "last_timestamp": 20,
+         "stage_path": ["a", "b"], "key": "K"},
+    ]
+    with IntrospectionServer(
+        registry=reg, tracer=tracer, match_exemplars=lambda n: exemplars[:n],
+    ) as srv:
+        doc = _get_json(srv.url + "/tracez?format=chrome")
+        events = doc["traceEvents"]
+        assert isinstance(events, list) and events
+        for e in events:
+            assert "name" in e and "ph" in e and "pid" in e
+            if e["ph"] != "M":
+                assert isinstance(e["ts"], (int, float))
+        assert any(e["name"] == "poll" for e in events)
+        match = next(e for e in events if e["name"] == "q")
+        assert match["args"]["stage_path"] == ["a", "b"]
+        # ?kind/?limit behavior is untouched by the format switch.
+        tz = _get_json(srv.url + "/tracez")
+        assert tz["kind"] == "span"
+
+
+def test_profilez_arms_capture_and_reports_busy(tmp_path):
+    reg = MetricsRegistry()
+    tracer = SpanTracer(reg)
+    with IntrospectionServer(
+        registry=reg, tracer=tracer, profile_dir=str(tmp_path),
+    ) as srv:
+        pz = _get_json(srv.url + "/profilez?secs=0")
+        assert pz["armed"] is True
+        assert pz["log_dir"] == str(tmp_path)
+        # The capture wall lands as a device_trace span once done.
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if any(
+                s["span"] == "device_trace" for s in tracer.recent(16)
+            ):
+                break
+            time.sleep(0.01)
+        assert any(s["span"] == "device_trace" for s in tracer.recent(16))
+    # Busy arbitration: a long capture refuses a second concurrent arm.
+    with IntrospectionServer(
+        registry=reg, tracer=tracer, profile_dir=str(tmp_path),
+    ) as srv:
+        first = _get_json(srv.url + "/profilez?secs=30")
+        assert first["armed"] is True
+        second = _get_json(srv.url + "/profilez?secs=1")
+        assert second == {"armed": False, "busy": True}
+    # Context exit stopped the 30s capture early (stop() sets the event
+    # and joins) -- reaching here quickly IS the assertion.
+
+
+def test_profilez_degraded_profiler_still_answers(monkeypatch, tmp_path):
+    import jax
+
+    monkeypatch.setattr(
+        jax.profiler, "trace",
+        lambda d: (_ for _ in ()).throw(RuntimeError("no profiler")),
+    )
+    reg = MetricsRegistry()
+    with IntrospectionServer(
+        registry=reg, tracer=SpanTracer(reg), profile_dir=str(tmp_path),
+    ) as srv:
+        pz = _get_json(srv.url + "/profilez?secs=0")
+        assert pz["armed"] is True  # armed; the capture no-ops inside
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if "cep_profiler_unavailable" in reg.snapshot():
+                break
+            time.sleep(0.01)
+        assert "cep_profiler_unavailable" in reg.snapshot()
+
+
+# --------------------------------------------------------- driver close
+def test_driver_close_joins_clock_thread_before_teardown():
+    """Satellite (ISSUE 9): close() must stop the introspection plane --
+    joining its clock thread -- BEFORE tearing down driver state, so no
+    tick can drive maybe_report()/health reads mid-teardown
+    (disarm_reporter only covered the report_every_s=None race)."""
+    log = RecordLog()
+    for i, ch in enumerate("XABC"):
+        produce(log, "letters", "K", ch, timestamp=i)
+    reg = MetricsRegistry()
+    topo = _letters_pipeline("host", reg, log)
+    reports = []
+    driver = LogDriver(
+        topo, group="close", registry=reg,
+        report_every_s=0.01, reporter=reports.append,
+    )
+    srv = driver.serve_http(tick_every_s=0.01)
+    driver.poll()
+    deadline = time.time() + 5.0
+    while not reports and time.time() < deadline:
+        time.sleep(0.005)
+    assert reports  # the clock thread is live and reporting
+    driver.close()
+    # The plane is fully down: both threads joined, handle cleared.
+    assert driver.http is None
+    assert srv._clock_thread is None and srv._serve_thread is None
+    assert srv._httpd is None
+    # No tick can fire a report after close returned.
+    n = len(reports)
+    time.sleep(0.08)
+    assert len(reports) == n
+    assert driver.maybe_report() is False  # reporter disarmed
+    # The pump refuses further work; close is idempotent.
+    with pytest.raises(RuntimeError, match="closed"):
+        driver.poll()
+    with pytest.raises(RuntimeError, match="closed"):
+        driver.serve_http()
+    driver.close()
+
+
+def test_driver_context_manager_closes():
+    log = RecordLog()
+    for i, ch in enumerate("ABC"):
+        produce(log, "letters", "K", ch, timestamp=i)
+    reg = MetricsRegistry()
+    topo = _letters_pipeline("host", reg, log)
+    with LogDriver(topo, group="cm", registry=reg) as driver:
+        srv = driver.serve_http()
+        assert driver.poll() == 3
+    # __exit__ closed: plane down, final positions committed.
+    assert driver.http is None and srv._httpd is None
+    assert driver._committed[("letters", 0)] == 3

@@ -269,11 +269,17 @@ def test_advance_zero_device_syncs_with_metrics_enabled(monkeypatch):
     # provenance_sample=1.0: lineage sampling rides the decode worker, so
     # the zero-sync advance contract must hold with it armed (ISSUE 7
     # acceptance; latency stamping is host-side at the streams layer and
-    # never touches the engine).
+    # never touches the engine). profile_every=64 (ISSUE 9): sampled
+    # phase profiling syncs ONLY every N-th advance -- the warmup below
+    # is batch 0 (the one sampled advance), so the counted window's
+    # advances are all untouched and must stay zero-sync with the dial
+    # armed. Compile telemetry is on by default: warm signatures pay a
+    # host-side dict lookup only.
     bat = BatchedDeviceNFA(
         query, keys=["x"],
         config=EngineConfig(lanes=8, nodes=128, matches=1024),
         provenance_sample=1.0,
+        profile_every=64,
     )
     # Warm every jitted program incl. a match-bearing drain OUTSIDE the
     # counted window.
@@ -485,8 +491,38 @@ def _valid_artifact():
             "http_server": True,
             "http_endpoints_ok": True,
             "served_matches_snapshot": True,
+            "chrome_trace_ok": True,
+            "profilez_armed": True,
         },
         "metrics_merged": reg.snapshot(),
+        # ISSUE 9: compile telemetry + regression verdict blocks.
+        "compile": {
+            "fns": {
+                "advance": {
+                    "compiles": 1, "seconds": 0.5,
+                    "flops": 1024.0, "bytes": None,
+                },
+            },
+            "total_compiles": 1,
+            "total_seconds": 0.5,
+        },
+        "regression": {
+            "prior": "BENCH_r05.json",
+            "tolerance": 0.15,
+            "missing_configs": [],
+            "configs": {
+                "skip_any8_batched": {
+                    "eps": {
+                        "prev": 100.0, "cur": 120.0,
+                        "delta_pct": 20.0, "regressed": False,
+                    },
+                },
+            },
+            "regressed": False,
+            "excused": False,
+            "tunnel_degraded_prev": False,
+            "tunnel_degraded_cur": False,
+        },
     }
 
 
@@ -535,6 +571,27 @@ def test_bench_schema_validates_observation_and_latency_blocks():
     fam["count"] = fam["count"] + 3
     errors = validate_bench_schema(art4)
     assert any("metrics_merged round-trip" in e for e in errors)
+
+
+def test_bench_schema_validates_compile_and_regression_blocks():
+    # compile: documented keys both ways, down to per-fn entries.
+    art = _valid_artifact()
+    del art["compile"]["total_compiles"]
+    art["compile"]["fns"]["advance"]["surprise"] = 1
+    errors = validate_bench_schema(art)
+    assert any("total_compiles" in e for e in errors)
+    assert any("surprise" in e for e in errors)
+    # regression: None is the documented no---compare shape...
+    art2 = _valid_artifact()
+    art2["regression"] = None
+    assert validate_bench_schema(art2) == []
+    # ...but a populated block is checked down to per-metric entries.
+    art3 = _valid_artifact()
+    del art3["regression"]["excused"]
+    art3["regression"]["configs"]["skip_any8_batched"]["eps"]["extra"] = 1
+    errors = validate_bench_schema(art3)
+    assert any("excused" in e for e in errors)
+    assert any("extra" in e for e in errors)
 
 
 def test_bench_schema_catches_metrics_roundtrip_corruption():

@@ -1,15 +1,22 @@
-"""Observability: per-batch timings + match-emit latency histogram."""
+"""Observability: per-batch timings, match-emit latency histogram,
+sampled phase profiling (profile_every), compile telemetry, and the
+device_trace fallback (ISSUE 9)."""
 from __future__ import annotations
 
 import numpy as np
+import pytest
 
 from kafkastreams_cep_tpu import QueryBuilder, compile_pattern
 from kafkastreams_cep_tpu.core.event import Event
+from kafkastreams_cep_tpu.obs import CompileWatch, MetricsRegistry, SpanTracer
 from kafkastreams_cep_tpu.ops.engine import EngineConfig
-from kafkastreams_cep_tpu.ops.profiling import BatchTimings
+from kafkastreams_cep_tpu.ops.profiling import BatchTimings, device_trace
 from kafkastreams_cep_tpu.ops.tables import compile_query
 from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA
 from kafkastreams_cep_tpu.pattern.expressions import value
+
+# `pytest -m profiling` selects the performance-observability suite.
+pytestmark = pytest.mark.profiling
 
 
 def test_batch_timings_summary_and_histogram():
@@ -74,3 +81,264 @@ def test_engine_records_timings():
     assert c["drain_pull_ms"] > 0 and c["drain_bytes"] > 0
     assert c["tunnel_mbps"] is None or c["tunnel_mbps"] > 0
     assert bat.drain_pull_bytes > 0
+
+
+def _letters_query():
+    pattern = (
+        QueryBuilder()
+        .select("a").where(value() == "A")
+        .then().select("b").where(value() == "B")
+        .then().select("c").where(value() == "C")
+        .build()
+    )
+    return compile_query(compile_pattern(pattern), None)
+
+
+def _noise_batch(bat, b, n=4):
+    return bat.pack({"x": [
+        Event("x", "Z", 1_000_000 + 10 * b + i, "t", 0, 100 + 10 * b + i)
+        for i in range(n)
+    ]})
+
+
+# -------------------------------------------------------- profile_every
+def test_profile_every_syncs_only_every_nth_advance(monkeypatch):
+    """The sampled phase-timing dial (ISSUE 9): profile_every=2 blocks on
+    advances 0, 2, 4 only (two blocks each: post-advance and post-post),
+    and every CLEAN sampled advance feeds one observation per phase into
+    cep_advance_compute_seconds -- batch 0 traced+compiled, so its wall
+    belongs to cep_compile_seconds and is excluded from the compute
+    histogram -- while the other advances keep the zero-sync pipeline
+    (same detector as the zero-sync pin)."""
+    bat = BatchedDeviceNFA(
+        _letters_query(), keys=["x"],
+        config=EngineConfig(lanes=8, nodes=128, matches=1024),
+        profile_every=2,
+    )
+    import jax as jax_mod
+
+    calls = {"block": 0}
+    real_block = jax_mod.block_until_ready
+    monkeypatch.setattr(
+        jax_mod, "block_until_ready",
+        lambda *a, **k: calls.__setitem__("block", calls["block"] + 1)
+        or real_block(*a, **k),
+    )
+    for b in range(5):  # batches 0..4: sampled at 0, 2, 4
+        bat.advance_packed(_noise_batch(bat, b), decode=False)
+    assert calls["block"] == 6  # 3 sampled advances x 2 phase blocks
+    snap = bat.metrics.snapshot()
+    per_phase = {
+        v["labels"]["phase"]: v["count"]
+        for v in snap["cep_advance_compute_seconds"]["values"]
+    }
+    # Batch 0 compiled (cep_compiles_total moved) -> its compile wall is
+    # excluded; batches 2 and 4 are warm compute observations.
+    assert per_phase == {"advance": 2, "post": 2}
+    compiles = {
+        v["labels"]["fn"]: v["value"]
+        for v in snap["cep_compiles_total"]["values"]
+    }
+    assert compiles["advance"] == 1
+
+
+def test_profile_sync_feeds_compute_histogram_and_validation():
+    bat = BatchedDeviceNFA(
+        _letters_query(), keys=["x"],
+        config=EngineConfig(lanes=8, nodes=128, matches=1024),
+        profile_sync=True,
+    )
+    for b in range(2):
+        bat.advance_packed(_noise_batch(bat, b), decode=False)
+    snap = bat.metrics.snapshot()
+    per_phase = {
+        v["labels"]["phase"]: v["count"]
+        for v in snap["cep_advance_compute_seconds"]["values"]
+    }
+    # Batch 0 compiled -> compile-wall guard excludes it; batch 1 is the
+    # clean compute observation.
+    assert per_phase == {"advance": 1, "post": 1}
+    with pytest.raises(ValueError, match="profile_every"):
+        BatchedDeviceNFA(
+            _letters_query(), keys=["x"],
+            config=EngineConfig(lanes=8, nodes=128, matches=16),
+            profile_every=0,
+        )
+
+
+# ----------------------------------------------------- compile telemetry
+def test_compile_watch_counts_signatures_and_estimates_cost():
+    import jax
+    import jax.numpy as jnp
+
+    reg = MetricsRegistry()
+    watch = CompileWatch(reg)
+    fn = watch.wrap(jax.jit(lambda x: x @ x), "mm")
+    fn(jnp.ones((8, 8)))
+    fn(jnp.ones((8, 8)))          # warm: same signature, no new compile
+    assert watch.compiles("mm") == 1
+    fn(jnp.ones((16, 16)))        # new shape -> new compile
+    assert watch.compiles("mm") == 2
+    snap = reg.snapshot()
+    secs = {
+        v["labels"]["fn"]: v["count"]
+        for v in snap["cep_compile_seconds"]["values"]
+    }
+    assert secs["mm"] == 2
+    # cost_analysis estimates landed for the matmul lowering (CPU XLA
+    # provides flops/bytes for it).
+    flops = {
+        v["labels"]["fn"]: v["value"]
+        for v in snap.get("cep_compile_flops", {}).get("values", ())
+    }
+    assert flops.get("mm", 0) > 0
+
+
+def test_compile_watch_distinguishes_programs_sharing_label():
+    """Two DISTINCT programs under one label with identical arg shapes
+    (the per-(Mb, Cb) flatten buckets fed by the shape-padded window
+    view) are two compiles -- the per-wrap token keeps bucket churn
+    visible instead of collapsing it into the first bucket's entry."""
+    import jax
+    import jax.numpy as jnp
+
+    reg = MetricsRegistry()
+    watch = CompileWatch(reg, estimate_cost=False)
+    f1 = watch.wrap(jax.jit(lambda x: x + 1), "flatten")
+    f2 = watch.wrap(jax.jit(lambda x: x * 2), "flatten")
+    f1(jnp.ones(8))
+    f2(jnp.ones(8))  # same shapes, different program
+    assert watch.compiles("flatten") == 2
+    f1(jnp.ones(8))
+    f2(jnp.ones(8))  # both warm now
+    assert watch.compiles("flatten") == 2
+    assert watch.seen_count == 2
+
+
+def test_engine_compile_telemetry_tracks_retraces():
+    """A [T, K] shape change (a retrace/recompile) moves the engine's
+    compile counters; a same-shape advance does not."""
+    bat = BatchedDeviceNFA(
+        _letters_query(), keys=["x"],
+        config=EngineConfig(lanes=8, nodes=128, matches=1024),
+    )
+    bat.advance_packed(_noise_batch(bat, 0, n=4), decode=False)
+    snap = bat.metrics.snapshot()
+    compiles = {
+        v["labels"]["fn"]: v["value"]
+        for v in snap["cep_compiles_total"]["values"]
+    }
+    assert compiles["advance"] == 1 and compiles["append"] == 1
+    base = compiles["advance"]
+    bat.advance_packed(_noise_batch(bat, 1, n=4), decode=False)  # warm
+    snap = bat.metrics.snapshot()
+    compiles = {
+        v["labels"]["fn"]: v["value"]
+        for v in snap["cep_compiles_total"]["values"]
+    }
+    assert compiles["advance"] == base
+    bat.advance_packed(_noise_batch(bat, 2, n=7), decode=False)  # T changed
+    snap = bat.metrics.snapshot()
+    compiles = {
+        v["labels"]["fn"]: v["value"]
+        for v in snap["cep_compiles_total"]["values"]
+    }
+    assert compiles["advance"] == base + 1
+    # The compile walls are on the same registry (the artifact's
+    # `compile` block reads them).
+    secs = {
+        v["labels"]["fn"]: v["sum"]
+        for v in snap["cep_compile_seconds"]["values"]
+    }
+    assert secs["advance"] > 0
+    # Opt-out: compile_telemetry=False registers nothing.
+    bat2 = BatchedDeviceNFA(
+        _letters_query(), keys=["x"],
+        config=EngineConfig(lanes=8, nodes=128, matches=16),
+        compile_telemetry=False,
+    )
+    bat2.advance({"x": [Event("x", "Z", 1_000_000, "t", 0, 0)]})
+    assert "cep_compiles_total" not in bat2.metrics.snapshot()
+
+
+def test_drain_flatten_bucket_growth_counts_as_compiles():
+    """Flatten-bucket churn is the recompile-storm signal: a drain that
+    needs a new (Mb, Cb) bucket compiles one more `flatten` program."""
+    bat = BatchedDeviceNFA(
+        _letters_query(), keys=["x"],
+        config=EngineConfig(lanes=8, nodes=128, matches=16),
+    )
+    out = bat.advance({"x": [
+        Event("x", v, 1_000_000 + i, "t", 0, i)
+        for i, v in enumerate("XABC")
+    ]})
+    assert sum(len(v) for v in out.values()) == 1
+    snap = bat.metrics.snapshot()
+    compiles = {
+        v["labels"]["fn"]: v["value"]
+        for v in snap["cep_compiles_total"]["values"]
+    }
+    assert compiles.get("drain_probe", 0) >= 1
+    assert compiles.get("flatten", 0) >= 1
+
+
+# ------------------------------------------------- device_trace fallback
+def test_device_trace_degrades_to_noop_with_warning_gauge(
+    monkeypatch, tmp_path
+):
+    """Satellite (ISSUE 9): an unavailable profiler (no TPU / missing
+    plugin) must degrade the capture to a no-op with a persistent
+    warning gauge -- never raise into the pipeline."""
+    import jax
+
+    def _broken(log_dir):
+        raise RuntimeError("profiler plugin missing")
+
+    monkeypatch.setattr(jax.profiler, "trace", _broken)
+    reg = MetricsRegistry()
+    ran = []
+    with device_trace(str(tmp_path), registry=reg):
+        ran.append(1)  # the enclosed block still runs
+    assert ran == [1]
+    snap = reg.snapshot()
+    vals = snap["cep_profiler_unavailable"]["values"]
+    assert vals[0]["value"] == 1
+    assert "profiler plugin missing" in vals[0]["labels"]["reason"]
+
+
+def test_device_trace_finalize_failure_degrades_too(monkeypatch, tmp_path):
+    import jax
+
+    class _BrokenExit:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            raise RuntimeError("xplane serialization failed")
+
+    monkeypatch.setattr(jax.profiler, "trace", lambda d: _BrokenExit())
+    reg = MetricsRegistry()
+    with device_trace(str(tmp_path), registry=reg):
+        pass  # must not raise
+    assert reg.snapshot()["cep_profiler_unavailable"]["values"][0]["value"] == 1
+    # ...and it never masks the block's own exception.
+    with pytest.raises(KeyError, match="real"):
+        with device_trace(str(tmp_path), registry=reg):
+            raise KeyError("real")
+
+
+def test_span_tracer_device_records_span_despite_broken_profiler(
+    monkeypatch, tmp_path
+):
+    import jax
+
+    monkeypatch.setattr(
+        jax.profiler, "trace",
+        lambda d: (_ for _ in ()).throw(RuntimeError("no profiler")),
+    )
+    reg = MetricsRegistry()
+    tracer = SpanTracer(reg)
+    with tracer.device(str(tmp_path)):
+        pass
+    assert tracer.recent(8)[0]["span"] == "device_trace"
+    assert "cep_profiler_unavailable" in reg.snapshot()
