@@ -29,6 +29,14 @@ from .streams.log import RecordLog
 from .streams.processor import CEPProcessor
 from .streams.serde import Queried, sequence_to_json
 from .obs import MetricsRegistry, SpanTracer, default_registry
+from .time import (
+    ArrivalOrderWatermark,
+    BoundedOutOfOrderness,
+    EventTimeGate,
+    IdleTimeout,
+    MinMergeWatermark,
+    ReorderBuffer,
+)
 
 __version__ = "0.1.0"
 
@@ -98,6 +106,12 @@ __all__ = [
     "MetricsRegistry",
     "SpanTracer",
     "default_registry",
+    "ArrivalOrderWatermark",
+    "BoundedOutOfOrderness",
+    "EventTimeGate",
+    "IdleTimeout",
+    "MinMergeWatermark",
+    "ReorderBuffer",
     # lazy device-path exports
     "DeviceNFA",
     "BatchedDeviceNFA",

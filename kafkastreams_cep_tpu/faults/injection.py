@@ -25,6 +25,10 @@ Named sites (the full set is `ALL_SITES`):
                           on the final path; CRC + last-good recover)
   log.torn_append         RecordLog.append: half a frame reaches the
                           segment file before the crash (reload truncates)
+  time.reorder_overflow   EventTimeGate.offer admission (transient -- the
+                          gate catches it and treats the reorder buffer
+                          as full NOW, so chaos schedules exercise the
+                          overflow policy path without filling a buffer)
 
 Crashes raise `InjectedCrash`, a BaseException subclass so no quarantine /
 best-effort `except Exception` in the pipeline can accidentally swallow a
@@ -64,10 +68,12 @@ CRASH_SITES: Tuple[str, ...] = (
     "log.torn_append",
 )
 #: Transient sites: the fault is recoverable in-process (TransientFault,
-#: caught by the retry wrapper at the site).
+#: caught at the site -- by the retry wrapper, or by the event-time
+#: gate's overflow hook, which reinterprets it as forced buffer pressure).
 TRANSIENT_SITES: Tuple[str, ...] = (
     "engine.device_step",
     "driver.restore",
+    "time.reorder_overflow",
 )
 ALL_SITES: Tuple[str, ...] = CRASH_SITES + TRANSIENT_SITES
 

@@ -56,6 +56,11 @@ FAULT_SERIES: Tuple[str, ...] = (
     "cep_driver_restore_failures_total",
     "cep_checkpoint_corrupt_total",
     "cep_emit_deduped_total",
+    # Event-time gate loss families (ISSUE 10, time/gate.py): records the
+    # reorder stage discarded -- late beyond the watermark under
+    # late_policy=drop, or reorder-buffer overflow under on_overflow=drop.
+    "cep_late_dropped_total",
+    "cep_reorder_overflow_dropped_total",
 )
 
 

@@ -76,6 +76,10 @@ from .tables import (
 _I32_MAX = np.int64(2**31 - 1)
 #: `pend_min` sentinel: no pending match (any real node id is smaller).
 _PEND_MIN_NONE = np.int32(2**31 - 1)
+#: Watermark-column fill when no watermark is threaded (ISSUE 10): the
+#: expiry clock is max(event ts, watermark), so this floor makes the clock
+#: bitwise-equal to the event timestamp -- today's arrival-order expiry.
+WM_NONE = np.int32(-(2**31))
 
 #: The observable per-key state counters every stats pull reduces (the
 #: `stats` / `shard_stats` / replay-handoff surfaces and the registry's
@@ -165,11 +169,42 @@ class EngineConfig:
     block_retries: int = 4
     #: Linear backoff step between blocked-admission retries (seconds).
     block_backoff_s: float = 0.0
+    #: Event-time subsystem (ISSUE 10, kafkastreams_cep_tpu/time/): per-key
+    #: reorder-buffer capacity ahead of the pack step. 0 disables the
+    #: event-time gate entirely (today's arrival-order semantics); > 0 arms
+    #: a bounded binary-heap buffer that releases records in event-time
+    #: order as the watermark advances. Overflow honors `on_overflow`
+    #: (drop = lose the incoming record loudly, raise = CEPOverflowError,
+    #: block = forced early release -- no loss, later stragglers go late).
+    reorder_capacity: int = 0
+    #: Bounded-out-of-orderness lateness (ms): the default watermark
+    #: generator trails the max observed event time by this bound, so any
+    #: record no more than `lateness_ms` behind the stream head reorders
+    #: cleanly; older records are late (see `late_policy`).
+    lateness_ms: int = 0
+    #: What happens to records older than the watermark (the Dataflow
+    #: late-data triad, Akidau et al. VLDB'15):
+    #:   "drop"           -- discard, counted in cep_late_dropped_total;
+    #:   "sideoutput"     -- divert to the gate's side output
+    #:                       (EventTimeGate.take_late), never the engine;
+    #:   "recompute-none" -- admit downstream as-is (best effort, no
+    #:                       retraction/recompute of already-expired
+    #:                       windows), counted in cep_late_admitted_total.
+    late_policy: str = "drop"
 
     def __post_init__(self) -> None:
         if self.on_overflow not in ("drop", "raise", "block"):
             raise ValueError(
                 f"on_overflow must be drop|raise|block, got {self.on_overflow!r}"
+            )
+        if self.late_policy not in ("drop", "sideoutput", "recompute-none"):
+            raise ValueError(
+                "late_policy must be drop|sideoutput|recompute-none, got "
+                f"{self.late_policy!r}"
+            )
+        if self.reorder_capacity < 0:
+            raise ValueError(
+                f"reorder_capacity must be >= 0, got {self.reorder_capacity}"
             )
 
     def dewey_width(self, query: CompiledQuery) -> int:
@@ -405,6 +440,18 @@ def build_step(
 
     def step(state: Dict[str, jnp.ndarray], x: Dict[str, jnp.ndarray], t: jnp.ndarray):
         ev_ts = x["ts"]
+        # Expiry clock (ISSUE 10): window expiry sweeps off event time as
+        # known by the watermark, not arrival order. Callers that thread no
+        # "wm" column (or fill it with WM_NONE) get max(ts, WM_NONE) == ts
+        # -- bitwise-identical to the historical arrival-order expiry. The
+        # event-time gate threads each release's monotone per-key clock:
+        # on its sorted release stream that equals the record's own
+        # timestamp (oracle-exact expiry), and it exceeds ts exactly where
+        # it must -- late admissions (recompute-none) and idle-advanced
+        # watermarks -- so the clock never rewinds and can expire runs
+        # whose window provably closed while no record carried a fresher
+        # timestamp.
+        ev_clk = jnp.maximum(ev_ts, x["wm"]) if "wm" in x else ev_ts
         gidx = x["gidx"]
 
         active = state["active"]
@@ -454,13 +501,13 @@ def build_step(
             eff_window = jnp.where(eps >= 0, w_eps, w_src)
             expired = (
                 active & (lane_ts >= 0) & (eff_window >= 0)
-                & ((ev_ts - lane_ts) > eff_window)
+                & ((ev_clk - lane_ts) > eff_window)
             )
         else:
             eff_window = jnp.where(eps >= 0, -1, w_src)
             expired = (
                 active & ~root_begin & (eff_window >= 0)
-                & ((ev_ts - lane_ts) > eff_window)
+                & ((ev_clk - lane_ts) > eff_window)
             )
         active = active & ~expired
 

@@ -232,13 +232,17 @@ def build_pallas_batched_advance(
         name for name, dt in query.schema.fields.items()
         if np.dtype(dt) == np.dtype(np.float32)
     ]
-    # xi column order: ts, topic, gidx, valid, ints..., spred..., gc_phase
-    # (the group's step offset -- rides the event columns so the kernel
-    # needs no extra input ref; every row of a batch carries the same
-    # value, read per key block as an (8, 1) scalar plane).
+    # xi column order: ts, topic, gidx, valid, ints..., spred..., gc_phase,
+    # wm (the group's step offset and the per-step watermark ride the event
+    # columns so the kernel needs no extra input ref; gc_phase is the same
+    # for every row of a batch, read per key block as an (8, 1) scalar
+    # plane; wm is the event-time watermark in force when the record was
+    # released -- WM_NONE when no event-time gate is armed, making the
+    # expiry clock bitwise-equal to the event timestamp).
     XI_BASE = 4
     PH_COL = XI_BASE + len(int_fields) + P
-    CI = PH_COL + 1
+    WM_COL = PH_COL + 1
+    CI = WM_COL + 1
     CF = len(f32_fields)
 
     # Per-lane stage lookups are unrolled selects over the static stage
@@ -386,6 +390,9 @@ def build_pallas_batched_advance(
         topic = xi[:, 1:2]
         gidx = xi[:, 2:3]
         valid = xi[:, 3:4] != 0  # (8, 1) bool
+        # Expiry clock (engine.py build_step): max(ts, watermark); the fill
+        # WM_NONE reduces it to ts exactly (arrival-order parity).
+        ev_clk = jnp.maximum(ev_ts, xi[:, WM_COL : WM_COL + 1])
         event: Dict[str, jnp.ndarray] = {"ts": ev_ts, "topic": topic}
         for ci, name in enumerate(int_fields):
             event[f"f:{name}"] = xi[:, XI_BASE + ci : XI_BASE + ci + 1]
@@ -433,13 +440,13 @@ def build_pallas_batched_advance(
             eff_window = jnp.where(eps >= 0, w_eps, w_src)
             expired = (
                 active & (lane_ts >= 0) & (eff_window >= 0)
-                & ((ev_ts - lane_ts) > eff_window)
+                & ((ev_clk - lane_ts) > eff_window)
             )
         else:
             eff_window = jnp.where(eps >= 0, -1, w_src)
             expired = (
                 active & ~root_begin & (eff_window >= 0)
-                & ((ev_ts - lane_ts) > eff_window)
+                & ((ev_clk - lane_ts) > eff_window)
             )
         active = active & ~expired
 
@@ -916,6 +923,15 @@ def build_pallas_batched_advance(
         phase = jnp.broadcast_to(
             state["gc_phase"].astype(jnp.int32)[None, :], (T, K)
         )
+        # Per-step watermark column (ISSUE 10): absent when no event-time
+        # gate is armed -- the WM_NONE fill keeps the kernel's expiry
+        # clock bitwise-equal to the event timestamp.
+        if "wm" in xs:
+            wm = xs["wm"].astype(jnp.int32)
+        else:
+            from .engine import WM_NONE
+
+            wm = jnp.full((T, K), WM_NONE, jnp.int32)
         xi_cols = [
             xs["ts"].astype(jnp.int32),
             xs["topic"].astype(jnp.int32),
@@ -925,7 +941,7 @@ def build_pallas_batched_advance(
         xi_cols += [xs[f"f:{n}"].astype(jnp.int32) for n in int_fields]
         xi = jnp.concatenate(
             [c[:, :, None] for c in xi_cols]
-            + [spred.astype(jnp.int32), phase[:, :, None]],
+            + [spred.astype(jnp.int32), phase[:, :, None], wm[:, :, None]],
             axis=2,
         )
         if CF:

@@ -35,6 +35,7 @@ from .engine import (
     DROP_COUNTER_KEYS,
     STATE_COUNTER_KEYS,
     WINDOW_PLANES,
+    WM_NONE,
     EngineConfig,
     build_append_post,
     build_batch_fn,
@@ -186,16 +187,28 @@ class DeviceNFA:
             )
         return out
 
-    def advance(self, events: List[Event], decode: bool = True) -> List[Sequence]:
+    def advance(
+        self,
+        events: List[Event],
+        decode: bool = True,
+        watermark_ms: Optional[Any] = None,
+    ) -> List[Sequence]:
         """Process a micro-batch; returns completed matches in oracle order.
 
         decode=False defers match materialization (no device sync): matches
         accumulate in the pool's pending buffer -- GC roots, so their chains
         stay alive and id-consistent -- until `drain()`.
+
+        `watermark_ms` (ISSUE 10) threads the event-time watermark into the
+        jitted step so window expiry (`n_expired`) sweeps off event time
+        instead of arrival order: a scalar (absolute ms, applied to every
+        step) or a per-event sequence of absolute-ms values (None entries
+        fall back to the event's own timestamp). Omitted, expiry is
+        bitwise-identical to the historical arrival-order behavior.
         """
         if not events:
             return []
-        xs = self._pack(events)
+        xs = self._pack(events, watermark_ms=watermark_ms)
         if _flt.ACTIVE is None:
             self.state, ys = self._advance(self.state, xs)
         else:
@@ -383,7 +396,9 @@ class DeviceNFA:
         return matches
 
     # ------------------------------------------------------------ internals
-    def _pack(self, events: List[Event]) -> Dict[str, jnp.ndarray]:
+    def _pack(
+        self, events: List[Event], watermark_ms: Optional[Any] = None
+    ) -> Dict[str, jnp.ndarray]:
         if self._ts_base is None:
             self._ts_base = int(events[0].timestamp)
         schema = self.query.schema
@@ -402,6 +417,10 @@ class DeviceNFA:
         xs["spred"] = eval_stateless_preds(self.query, cols)
         xs["gidx"] = jnp.asarray(gidx)
         xs["valid"] = jnp.ones(T, bool)
+        if watermark_ms is not None:
+            xs["wm"] = jnp.asarray(
+                rebase_watermarks(watermark_ms, T, self._ts_base)
+            )
         return xs
 
     def _decode_matches(self) -> List[Sequence]:
@@ -532,6 +551,34 @@ class DeviceNFA:
         self._events = {g: e for g, e in self._events.items() if g in live_gidx}
 
 
+def rebase_watermarks(
+    watermark_ms: Any, n: int, ts_base: int
+) -> np.ndarray:
+    """Absolute-ms watermark(s) -> rebased i32 "wm" column of shape [n].
+
+    Accepts a scalar (broadcast to every step) or a per-event sequence;
+    None entries (and a None scalar) fall back to WM_NONE, which the step's
+    max(ts, wm) clock reduces to the event's own timestamp. Values clamp
+    into i32 so a huge watermark (end-of-stream flush) compares identically
+    to "expire everything expirable"."""
+    lo, hi = int(WM_NONE), 2**31 - 1
+    if np.isscalar(watermark_ms) or watermark_ms is None:
+        seq = [watermark_ms] * n
+    else:
+        seq = list(watermark_ms)
+        if len(seq) != n:
+            raise ValueError(
+                f"watermark sequence length {len(seq)} != batch length {n}"
+            )
+    out = np.empty(n, np.int32)
+    for i, w in enumerate(seq):
+        if w is None:
+            out[i] = WM_NONE
+        else:
+            out[i] = int(min(max(int(w) - ts_base, lo), hi))
+    return out
+
+
 def decode_chains(
     start_nodes: np.ndarray,
     node_name: np.ndarray,
@@ -581,11 +628,16 @@ def sequence_provenance(
     stage path and Dewey-style version-path depth from the group walk
     (DeweyVersion.add_stage appends one digit per stage entered), chain
     depth from the hop count, and the window span from the first/last
-    events' source-log coordinates. Event order within the walk is the
-    Event contract's ((topic, partition, offset) / timestamp fallback)."""
+    events' source-log coordinates. Offsets follow the Event contract's
+    ((topic, partition, offset) / timestamp fallback) order; the TIMESTAMP
+    span is taken over raw event time instead (ISSUE 10): behind a reorder
+    stage an out-of-order source's log order no longer tracks event time,
+    and the provenance window must report the event-time span the match
+    actually covered, not the arrival span."""
     events = [e for staged in seq.matched for e in staged.events]
     first = min(events) if events else None
     last = max(events) if events else None
+    ts = [e.timestamp for e in events]
     return MatchProvenance(
         query=query,
         trigger=trigger,
@@ -594,8 +646,8 @@ def sequence_provenance(
         branch_depth=len(seq.matched),
         first_offset=first.offset if first is not None else -1,
         last_offset=last.offset if last is not None else -1,
-        first_timestamp=first.timestamp if first is not None else -1,
-        last_timestamp=last.timestamp if last is not None else -1,
+        first_timestamp=min(ts) if ts else -1,
+        last_timestamp=max(ts) if ts else -1,
     )
 
 

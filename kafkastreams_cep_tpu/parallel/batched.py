@@ -32,11 +32,12 @@ from ..ops.engine import (
     DROP_COUNTER_KEYS,
     STATE_COUNTER_KEYS,
     WINDOW_PLANES,
+    WM_NONE,
     EngineConfig,
     drain_pend,
     eval_stateless_preds,
 )
-from ..ops.runtime import decode_chains, materialize_sequence
+from ..ops.runtime import decode_chains, materialize_sequence, rebase_watermarks
 from ..ops.schema import EventSchema
 from ..ops.tables import CompiledQuery, compile_query
 from ..pattern.stages import Stages
@@ -644,7 +645,9 @@ class BatchedDeviceNFA:
         )
 
     def pack(
-        self, events_by_key: Mapping[Any, Seq[Event]]
+        self,
+        events_by_key: Mapping[Any, Seq[Event]],
+        watermarks: Optional[Any] = None,
     ) -> Dict[str, jnp.ndarray]:
         """Pack per-key event lists into time-major [T, K] device columns.
 
@@ -652,6 +655,13 @@ class BatchedDeviceNFA:
         absent from the mapping are all-padding for this batch. Work (and
         global event-id allocation) is O(real events): padding slots are
         numpy fills carrying gidx -1, never Python-per-slot loops.
+
+        `watermarks` (ISSUE 10) threads the event-time watermark into the
+        jitted step as a per-step "wm" column so window expiry sweeps off
+        event time: a scalar (absolute ms, every real slot) or a mapping
+        key -> per-event sequence / scalar mirroring `events_by_key`.
+        Omitted, no "wm" column is packed and expiry stays bitwise the
+        historical arrival-order behavior.
         """
         lists: List[Seq[Event]] = [() for _ in range(self.K_padded)]
         T = 0
@@ -758,6 +768,27 @@ class BatchedDeviceNFA:
         xs["spred"] = eval_stateless_preds(self.query, cols)
         xs["gidx"] = jnp.asarray(gidx)
         xs["valid"] = jnp.asarray(valid)
+        if watermarks is not None:
+            wm_col = np.full((T, K), WM_NONE, np.int32)
+            if np.isscalar(watermarks):
+                for k, evs in enumerate(lists):
+                    if evs:
+                        wm_col[: len(evs), k] = rebase_watermarks(
+                            watermarks, len(evs), self._ts_base
+                        )
+            else:
+                for key, wms in watermarks.items():
+                    idx = self.key_index.get(key)
+                    if idx is None:
+                        raise KeyError(
+                            f"unknown key {key!r} (fixed at construction)"
+                        )
+                    n = len(lists[idx])
+                    if n:
+                        wm_col[:n, idx] = rebase_watermarks(
+                            wms, n, self._ts_base
+                        )
+            xs["wm"] = jnp.asarray(wm_col)
         if self.mesh is not None:
             xs = shard_xs(xs, self.mesh)
         self._pack_hwms.append(self._next_gidx - 1)
@@ -768,10 +799,15 @@ class BatchedDeviceNFA:
         return xs
 
     def advance(
-        self, events_by_key: Mapping[Any, Seq[Event]]
+        self,
+        events_by_key: Mapping[Any, Seq[Event]],
+        watermarks: Optional[Any] = None,
     ) -> Dict[Any, List[Sequence]]:
-        """Pack, advance all keys one micro-batch, decode per-key matches."""
-        return self.advance_packed(self.pack(events_by_key))
+        """Pack, advance all keys one micro-batch, decode per-key matches.
+
+        `watermarks` threads the event-time watermark into the step (see
+        `pack`); omitted, expiry keeps arrival-order parity bitwise."""
+        return self.advance_packed(self.pack(events_by_key, watermarks))
 
     def advance_packed(
         self, xs: Dict[str, jnp.ndarray], decode: bool = True

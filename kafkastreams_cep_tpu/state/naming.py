@@ -16,6 +16,9 @@ AGGREGATES_SUFFIX = "-streamscep-aggregates"
 #: reference trio so operators find one layout.
 EMITTED_SUFFIX = "-streamscep-emitted"
 DEVICE_STATE_SUFFIX = "-streamscep-devicestate"
+#: Host-runtime event-time gate store (ISSUE 10): reorder buffers +
+#: watermark state + arrival marks, snapshotted at every commit flush.
+EVENT_TIME_SUFFIX = "-streamscep-eventtime"
 
 
 def normalize_query_name(query_name: str) -> str:
@@ -42,3 +45,7 @@ def emitted_store(query_name: str) -> str:
 
 def device_state_store(query_name: str) -> str:
     return normalize_query_name(query_name) + DEVICE_STATE_SUFFIX
+
+
+def event_time_store(query_name: str) -> str:
+    return normalize_query_name(query_name) + EVENT_TIME_SUFFIX

@@ -626,3 +626,121 @@ class _EventOnly(CheckpointCodec):
     def __init__(self, serialize, deserialize) -> None:
         self._ser = serialize
         self._de = deserialize
+
+
+# ---------------------------------------------------------------------------
+# Event-time gate frames (ISSUE 10)
+# ---------------------------------------------------------------------------
+#: Wrapper tag for processor snapshots that carry event-time state
+#: alongside the legacy payload. Distinct from every KCT* magic, so
+#: `split_event_time` discriminates new and old formats unambiguously
+#: (old snapshots restore with a fresh gate -- replay rebuilds it).
+ET_MAGIC = b"KCW1"
+
+
+def encode_event_time_state(
+    state: Dict[str, Any],
+    serialize: Callable[[Any], bytes] = _default_serialize,
+) -> bytes:
+    """Seal an EventTimeGate.snapshot_state() dict: watermark-generator
+    kind + state, the monotone release clock, forced/observed marks, the
+    arrival sequence, every key's buffered (seq, Event) entries in
+    (ts, seq) order, and the late side output. Crash recovery restores the
+    reorder buffer and watermark CONSISTENTLY with the engine snapshot the
+    same commit wrote (streams/device_processor.py snapshot/restore)."""
+    codec = _EventOnly(serialize, _default_deserialize)
+    w = _Writer()
+    w._buf.write(MAGIC)
+    w.text(state["gen_kind"])
+    w.blob(pickle.dumps(state["gen_state"], protocol=pickle.HIGHEST_PROTOCOL))
+    clocks = state["clocks"]
+    w.i32(len(clocks))
+    for key in clocks:
+        w.blob(pickle.dumps(key, protocol=pickle.HIGHEST_PROTOCOL))
+        w.i64(clocks[key])
+    w.i64(state["forced_wm"])
+    w.i64(state["max_seen"])
+    w.i64(state["seq"])
+    buffers = state["buffers"]
+    w.i32(len(buffers))
+    for key in buffers:
+        w.blob(pickle.dumps(key, protocol=pickle.HIGHEST_PROTOCOL))
+        entries = buffers[key]
+        w.i32(len(entries))
+        for _ts, seq, ev in entries:
+            w.i64(seq)
+            codec._put_event(w, ev)
+    late = state["late"]
+    w.i32(len(late))
+    for ev in late:
+        codec._put_event(w, ev)
+    # Arrival high-water marks (host runtime): the arrival-side dedup
+    # marks MUST restore atomically with the gate contents they guard --
+    # a durable mark over a volatile buffer silently loses the buffered
+    # records on crash (the device runtime snapshots its marks in the
+    # same processor blob instead; it passes {} here).
+    w.blob(
+        pickle.dumps(state.get("hwm", {}), protocol=pickle.HIGHEST_PROTOCOL)
+    )
+    return seal_frame(w.getvalue())
+
+
+def decode_event_time_state(
+    data: bytes,
+    deserialize: Callable[[bytes], Any] = _default_deserialize,
+) -> Dict[str, Any]:
+    codec = _EventOnly(_default_serialize, deserialize)
+    r = _Reader(open_frame(data))
+    read_magic(r)
+    out: Dict[str, Any] = {
+        "gen_kind": r.text(),
+        "gen_state": pickle.loads(r.blob()),
+    }
+    clocks: Dict[Any, int] = {}
+    for _ in range(r.i32()):
+        ck = pickle.loads(r.blob())
+        clocks[ck] = r.i64()
+    out["clocks"] = clocks
+    out["forced_wm"] = r.i64()
+    out["max_seen"] = r.i64()
+    out["seq"] = r.i64()
+    buffers: Dict[Any, list] = {}
+    for _ in range(r.i32()):
+        key = pickle.loads(r.blob())
+        entries = []
+        for _ in range(r.i32()):
+            seq = r.i64()
+            ev = codec._get_event(r)
+            entries.append((ev.timestamp, seq, ev))
+        buffers[key] = entries
+    out["buffers"] = buffers
+    out["late"] = [codec._get_event(r) for _ in range(r.i32())]
+    out["hwm"] = pickle.loads(r.blob())
+    r.expect_end()
+    return out
+
+
+def wrap_event_time(inner: bytes, gate_bytes: bytes) -> bytes:
+    """Wrap a processor snapshot with its event-time gate frame."""
+    w = _Writer()
+    w._buf.write(ET_MAGIC)
+    w.blob(inner)
+    w.blob(gate_bytes)
+    return seal_frame(w.getvalue())
+
+
+def split_event_time(data: bytes) -> Tuple[bytes, Optional[bytes]]:
+    """(inner snapshot, gate frame | None): inverse of wrap_event_time.
+
+    Legacy snapshots (no wrapper) pass through untouched with gate None,
+    so pre-event-time checkpoints keep restoring."""
+    payload = open_frame(data)
+    if not payload.startswith(ET_MAGIC):
+        return data, None
+    r = _Reader(payload)
+    if r._read(4) != ET_MAGIC:  # pragma: no cover - startswith guarded
+        raise CheckpointError("bad event-time wrapper magic")
+    inner = r.blob()
+    gate = r.blob()
+    r.expect_end()
+    return inner, gate

@@ -144,6 +144,23 @@ class QueryNode(Generic[K, V]):
         self.store_builders = QueryStoreBuilders(name, pattern)
         self.stores: Dict[str, Any] = self.store_builders.build_all(log, app_id)
         self.stores[emit_name] = self.emission_store
+        # Event-time knobs for the HOST runtime ride the query kwargs
+        # directly (the device runtime reads them from EngineConfig).
+        # `on_overflow` is accepted as an alias for `reorder_overflow`
+        # (it is the EngineConfig spelling README documents); an explicit
+        # reorder_overflow wins when both are given.
+        et_opts = {
+            k: device_opts[k]
+            for k in (
+                "reorder_capacity", "lateness_ms", "late_policy",
+                "reorder_overflow", "watermark_gen",
+            )
+            if k in device_opts
+        }
+        if "on_overflow" in device_opts:
+            et_opts.setdefault(
+                "reorder_overflow", device_opts["on_overflow"]
+            )
         self.processor = CEPProcessor(
             name,
             self.store_builders.stages,
@@ -151,7 +168,105 @@ class QueryNode(Generic[K, V]):
             buffer=self.stores[event_buffer_store(name)],
             aggregates=self.stores[aggregates_store(name)],
             registry=registry,
+            **et_opts,
         )
+        if log is not None and self.processor.gate is not None:
+            from ..state.naming import event_time_store
+
+            et_name = event_time_store(self.name)
+            self.stores[et_name] = EventTimeStateStore(
+                self, log, changelog_topic(app_id, et_name),
+                registry=registry,
+            )
+
+
+class EventTimeStateStore:
+    """Changelog durability for a HOST query's event-time gate.
+
+    The host trio's changelogs restore through `restore_stores()`, but an
+    EventTimeGate lives outside them -- and its arrival marks must never
+    be MORE durable than the buffered records they dedup (a crash would
+    then silently lose every buffered record: the mark rejects the replay
+    while the buffer restored empty). This store snapshots the
+    processor's combined event-time state (gate contents + arrival
+    marks, `CEPProcessor.event_time_state()`) into
+    `<app>-<query>-streamscep-eventtime-changelog` at every commit flush
+    and restores the newest snapshot that validates, CRC-rejected tails
+    counted in `cep_checkpoint_corrupt_total`.
+
+    Commit atomicity caveat: like the reference trio itself (three
+    separate changelogs per query), a commit's appends are not one
+    atomic frame -- a torn flush can land the trio's records without
+    this store's snapshot. The store is registered AFTER the trio, so
+    iteration order makes the event-time snapshot the LAST append of a
+    flush: a tear restores OLDER arrival marks over NEWER run state,
+    which re-offers the window's records (duplicate-leaning,
+    deduplicated at the sink by the emission gate) instead of the
+    loss-leaning inverse. The device runtime sidesteps this class
+    entirely with its single-blob snapshot."""
+
+    def __init__(
+        self, node: "QueryNode", log: Any, topic: str,
+        registry: Optional[Any] = None,
+    ) -> None:
+        from ..obs.registry import default_registry
+        from ..state.naming import event_time_store
+
+        self.name = event_time_store(node.name)
+        self.node = node
+        self.log = log
+        self.topic = topic
+        self.metrics = registry if registry is not None else default_registry()
+        self._m_corrupt = self.metrics.counter(
+            "cep_checkpoint_corrupt_total",
+            "Checkpoint payloads rejected by CRC/framing validation",
+        )
+
+    @property
+    def persistent(self) -> bool:
+        return True
+
+    def flush(self) -> None:
+        if self.log is None:
+            return
+        from ..state.serde import encode_event_time_state
+
+        self.log.append(
+            self.topic, None,
+            encode_event_time_state(self.node.processor.event_time_state()),
+        )
+
+    def restore_from_changelog(self) -> int:
+        if self.log is None:
+            return 0
+        from ..state.serde import CheckpointError, decode_event_time_state
+
+        recs = self.log.read(self.topic)
+        for rec in reversed(recs):
+            if rec.value is None:
+                continue
+            try:
+                state = decode_event_time_state(rec.value)
+            except CheckpointError:
+                # Corrupt bytes: walk back to the previous generation.
+                self._m_corrupt.inc()
+                continue
+            try:
+                self.node.processor.restore_event_time(state)
+            except (ValueError, KeyError) as exc:
+                # A CRC-valid snapshot that the CONFIGURED gate cannot
+                # absorb is a configuration mismatch (changed watermark
+                # generator), not corruption: restoring an empty gate
+                # over committed consumer offsets would silently lose
+                # every buffered record -- fail like the processor
+                # restore paths do.
+                raise ValueError(
+                    f"{self.name}: event-time snapshot does not match the "
+                    f"configured watermark generator ({exc}); restore with "
+                    "the original event-time config"
+                ) from exc
+            return len(recs)
+        return len(recs)
 
 
 class CEPStream(Generic[K, V]):
@@ -299,6 +414,21 @@ class Topology:
         for stream, node, out in self.queries:
             if topic not in stream.topics:
                 continue
+            if (
+                node.runtime != "tpu"
+                and getattr(node.processor, "gate", None) is not None
+            ):
+                # Gated host runtime: one arrival can release OTHER keys'
+                # buffered records, so matches must be attributed (sink
+                # key, emission digest, latency anchor) to THEIR key and
+                # completing event -- the keyed path shares the device
+                # branch's per-match routing.
+                keyed = node.processor.process_keyed(
+                    key, value, timestamp=timestamp, topic=topic,
+                    partition=partition, offset=offset,
+                )
+                outputs.extend(self._emit_device(node, out, keyed))
+                continue
             results = node.processor.process(
                 key, value, timestamp=timestamp, topic=topic, partition=partition, offset=offset
             )
@@ -335,6 +465,34 @@ class Topology:
             if flush is None:
                 continue
             outputs.extend(self._emit_device(node, out, flush()))
+        return outputs
+
+    def tick_event_time(self, now_ms: int) -> List[Record]:
+        """Wall-clock tick for event-time gates (idle-source watermark
+        timeouts, ISSUE 10): both runtimes return [(key, Sequence)] for
+        matches completed by records the advanced watermark released.
+        No-op for queries without a gate."""
+        outputs: List[Record] = []
+        for _stream, node, out in self.queries:
+            tick = getattr(node.processor, "tick_event_time", None)
+            if tick is None:
+                continue
+            res = tick(now_ms)
+            if res:
+                outputs.extend(self._emit_device(node, out, res))
+        return outputs
+
+    def flush_event_time(self) -> List[Record]:
+        """End-of-stream: force-release every gate's buffered records in
+        event-time order and run them through the match loops."""
+        outputs: List[Record] = []
+        for _stream, node, out in self.queries:
+            fet = getattr(node.processor, "flush_event_time", None)
+            if fet is None:
+                continue
+            res = fet()
+            if res:
+                outputs.extend(self._emit_device(node, out, res))
         return outputs
 
     def _emit_device(

@@ -50,6 +50,7 @@ class DeviceCEPProcessor(Generic[K, V]):
         initial_keys: int = 8,
         mesh: Optional[Any] = None,
         registry: Optional[Any] = None,
+        watermark_gen: Optional[Any] = None,
         **engine_opts: Any,
     ) -> None:
         if isinstance(pattern_or_query, CompiledQuery):
@@ -90,9 +91,32 @@ class DeviceCEPProcessor(Generic[K, V]):
             "Sequences emitted by the device driver",
             labels=("query",),
         ).labels(query=self.query_name)
+        # Event-time gate (ISSUE 10, kafkastreams_cep_tpu/time/): armed by
+        # EngineConfig.reorder_capacity > 0. Arriving records buffer per
+        # key and release in event-time order as the watermark advances;
+        # each release carries the gate's monotone event-time clock, which
+        # flush() threads into the engine so window expiry sweeps off
+        # event time instead of arrival order.
+        self.gate = None
+        if self.config.reorder_capacity > 0:
+            from ..time import EventTimeGate
+
+            self.gate = EventTimeGate(
+                capacity=self.config.reorder_capacity,
+                lateness_ms=self.config.lateness_ms,
+                late_policy=self.config.late_policy,
+                on_overflow=self.config.on_overflow,
+                generator=watermark_gen,
+                registry=self.metrics,
+                query_name=self.query_name,
+            )
         self._lane_of_key: Dict[Any, _Lane] = {}
         self._next_lane = 0
         self._pending: Dict[Any, List[Event]] = {}
+        #: Per-key event-time clocks parallel to `_pending` (gate armed
+        #: only): _pending_wm[k][i] is the watermark clock attached to
+        #: _pending[k][i] at its release.
+        self._pending_wm: Dict[Any, List[int]] = {}
         self._pending_count = 0
         self._flushes = 0
         self._warned_low_keys = False
@@ -121,15 +145,54 @@ class DeviceCEPProcessor(Generic[K, V]):
         latest = self._hwm.get(hwm_key)
         if latest is not None and offset < latest:
             return []  # replayed record below the high-water mark
-        self._hwm[hwm_key] = offset + 1
-
-        self._pending.setdefault(key, []).append(
-            Event(key, value, timestamp, topic, partition, offset)
-        )
-        self._pending_count += 1
+        event = Event(key, value, timestamp, topic, partition, offset)
+        if self.gate is not None:
+            # Through the event-time gate: what the watermark releases --
+            # possibly other keys' records, possibly nothing -- enqueues
+            # with its release clock; the arriving record may buffer. The
+            # HWM advances only AFTER admission: a CEPOverflowError from
+            # on_overflow="raise" must leave the mark untouched, or the
+            # caller's retry of the rejected record would be deduped as a
+            # replay and the record silently lost.
+            released = self.gate.offer(event)
+            self._hwm[hwm_key] = offset + 1
+            self._enqueue_released(released)
+        else:
+            self._hwm[hwm_key] = offset + 1
+            self._pending.setdefault(key, []).append(event)
+            self._pending_count += 1
         if self._pending_count >= self.batch_size:
             return self.flush()
         return []
+
+    def _enqueue_released(self, released: List[Tuple[Event, int]]) -> None:
+        for ev, clk in released:
+            self._pending.setdefault(ev.key, []).append(ev)
+            self._pending_wm.setdefault(ev.key, []).append(clk)
+            self._pending_count += 1
+
+    def tick_event_time(self, now_ms: int) -> List[Tuple[K, Sequence[K, V]]]:
+        """Wall-clock tick for idle-source watermarks (driver poll
+        cadence): releases whatever the advanced watermark passed and
+        flushes if the batch filled. No-op without a gate."""
+        if self.gate is None:
+            return []
+        self._enqueue_released(self.gate.advance_wall(now_ms))
+        if self._pending_count >= self.batch_size:
+            return self.flush()
+        return []
+
+    def flush_event_time(self) -> List[Tuple[K, Sequence[K, V]]]:
+        """End-of-stream: force-release every buffered record in event-time
+        order and flush the resulting micro-batch."""
+        if self.gate is None:
+            return self.flush()
+        self._enqueue_released(self.gate.flush())
+        return self.flush()
+
+    def take_late(self) -> List[Event]:
+        """Drain the gate's late side output (late_policy=sideoutput)."""
+        return self.gate.take_late() if self.gate is not None else []
 
     #: flush count after which a persistently tiny key population triggers
     #: the runtime-choice warning (the device engine's parallelism axis is
@@ -157,13 +220,33 @@ class DeviceCEPProcessor(Generic[K, V]):
                 RuntimeWarning,
             )
         batch: Dict[_Lane, List[Event]] = {}
+        wms: Optional[Dict[_Lane, List[int]]] = (
+            {} if self.gate is not None else None
+        )
         for key, events in self._pending.items():
-            batch[self._lane_for(key)] = events
+            lane = self._lane_for(key)
+            batch[lane] = events
+            if wms is not None:
+                clocks = self._pending_wm.get(key, [])
+                if len(clocks) != len(events):
+                    # Pending events restored from a legacy (pre-event-
+                    # time) checkpoint carry no release clocks: pad with
+                    # None (arrival-parity expiry for those records)
+                    # instead of failing the first post-upgrade flush.
+                    # Pad at the FRONT -- the clock-less legacy events
+                    # sit ahead of any post-restore releases in
+                    # _pending[key], and clocks must stay aligned with
+                    # their own events.
+                    clocks = [None] * (len(events) - len(clocks)) + list(
+                        clocks
+                    )
+                wms[lane] = clocks
         self._pending = {}
+        self._pending_wm = {}
         self._pending_count = 0
 
         try:
-            advanced = self.engine.advance(batch)
+            advanced = self.engine.advance(batch, watermarks=wms)
         except (CEPOverflowError, TransientFault):
             raise
         except Exception:
@@ -172,7 +255,7 @@ class DeviceCEPProcessor(Generic[K, V]):
             # healthy remainder advances, the poison lands in
             # `self._poisoned` for the driver's DLQ (the pump keeps
             # advancing; ISSUE 6 quarantine contract).
-            advanced = self._advance_isolating(batch)
+            advanced = self._advance_isolating(batch, wms)
         out: List[Tuple[K, Sequence]] = []
         for lane, seqs in advanced.items():
             out.extend((lane.key, s) for s in seqs)
@@ -182,16 +265,24 @@ class DeviceCEPProcessor(Generic[K, V]):
         return out
 
     def _advance_isolating(
-        self, batch: Dict["_Lane", List[Event]]
+        self,
+        batch: Dict["_Lane", List[Event]],
+        wms: Optional[Dict["_Lane", List[int]]] = None,
     ) -> Dict["_Lane", List[Sequence]]:
         """Record-at-a-time fallback after a batch advance raised: each
         record advances alone (per-lane order preserved); records that
         still raise are quarantined instead of wedging the pump."""
         out: Dict[_Lane, List[Sequence]] = {}
         for lane, events in batch.items():
-            for ev in events:
+            lane_wms = wms.get(lane, []) if wms is not None else None
+            for i, ev in enumerate(events):
                 try:
-                    res = self.engine.advance({lane: [ev]})
+                    per_ev_wm = (
+                        {lane: [lane_wms[i]]}
+                        if lane_wms is not None and i < len(lane_wms)
+                        else None
+                    )
+                    res = self.engine.advance({lane: [ev]}, watermarks=per_ev_wm)
                 except (CEPOverflowError, TransientFault):
                     raise
                 except Exception as exc:
@@ -223,10 +314,24 @@ class DeviceCEPProcessor(Generic[K, V]):
 
     # --------------------------------------------------------- checkpointing
     def snapshot(self) -> bytes:
-        """Bytes-level checkpoint: engine state + lane map + HWM + pending."""
+        """Bytes-level checkpoint: engine state + lane map + HWM + pending.
+
+        With an event-time gate armed, the pending records' release clocks
+        ride the inner frame and the gate itself (reorder buffers +
+        watermark state) rides a wrapper frame
+        (state/serde.wrap_event_time), so crash recovery restores the
+        reorder buffer and the watermark CONSISTENTLY with the engine
+        state the same commit wrote."""
         import pickle
 
-        from ..state.serde import _Writer, MAGIC, encode_event_registry, seal_frame
+        from ..state.serde import (
+            _Writer,
+            MAGIC,
+            encode_event_registry,
+            encode_event_time_state,
+            seal_frame,
+            wrap_event_time,
+        )
 
         w = _Writer()
         w._buf.write(MAGIC)
@@ -236,7 +341,16 @@ class DeviceCEPProcessor(Generic[K, V]):
         for key, events in self._pending.items():
             w.blob(pickle.dumps(key, protocol=pickle.HIGHEST_PROTOCOL))
             w.blob(encode_event_registry(dict(enumerate(events))))
-        return seal_frame(w.getvalue())
+        if self.gate is not None:
+            w.blob(
+                pickle.dumps(self._pending_wm, protocol=pickle.HIGHEST_PROTOCOL)
+            )
+        inner = seal_frame(w.getvalue())
+        if self.gate is None:
+            return inner
+        return wrap_event_time(
+            inner, encode_event_time_state(self.gate.snapshot_state())
+        )
 
     @classmethod
     def restore(
@@ -250,6 +364,7 @@ class DeviceCEPProcessor(Generic[K, V]):
         initial_keys: int = 8,
         mesh: Optional[Any] = None,
         registry: Optional[Any] = None,
+        watermark_gen: Optional[Any] = None,
         **engine_opts: Any,
     ) -> "DeviceCEPProcessor":
         import pickle
@@ -257,15 +372,24 @@ class DeviceCEPProcessor(Generic[K, V]):
         from ..state.serde import (
             _Reader,
             decode_event_registry,
+            decode_event_time_state,
             open_frame,
             read_magic,
+            split_event_time,
         )
 
         proc = cls(
             query_name, pattern_or_query, schema=schema, config=config,
             batch_size=batch_size, initial_keys=initial_keys, mesh=mesh,
-            registry=registry, **engine_opts,
+            registry=registry, watermark_gen=watermark_gen, **engine_opts,
         )
+        data, gate_bytes = split_event_time(data)
+        if gate_bytes is not None and proc.gate is None:
+            raise ValueError(
+                "checkpoint carries event-time gate state but the restored "
+                "processor has no gate (EngineConfig.reorder_capacity == "
+                "0); restore with the original event-time config"
+            )
         r = _Reader(open_frame(data))
         read_magic(r)
         proc.engine = BatchedDeviceNFA.restore(
@@ -282,13 +406,18 @@ class DeviceCEPProcessor(Generic[K, V]):
         proc._next_lane = len(proc._lane_of_key)
         proc._hwm = pickle.loads(r.blob())
         proc._pending = {}
+        proc._pending_wm = {}
         proc._pending_count = 0
         for _ in range(r.i32()):
             key = pickle.loads(r.blob())
             events = decode_event_registry(r.blob())
             proc._pending[key] = [events[i] for i in sorted(events)]
             proc._pending_count += len(events)
+        if gate_bytes is not None:
+            proc._pending_wm = pickle.loads(r.blob())
         r.expect_end()
+        if gate_bytes is not None:
+            proc.gate.restore_state(decode_event_time_state(gate_bytes))
         return proc
 
     # ------------------------------------------------------------ internals

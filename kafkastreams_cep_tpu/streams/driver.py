@@ -238,6 +238,20 @@ class LogDriver:
     def position(self, topic: str, partition: int = 0) -> int:
         return self._positions.get((topic, partition), 0)
 
+    def drain_event_time(self, commit: bool = True) -> int:
+        """End-of-stream drain for event-time gates (ISSUE 10): force-
+        release every buffered record in event-time order, flush the
+        resulting micro-batches and commit. Returns how many matches the
+        drain emitted. A no-op (0) for topologies without a gate."""
+        if self._closed:
+            raise RuntimeError("LogDriver is closed")
+        emitted = self.topology.flush_event_time()
+        emitted.extend(self.topology.flush())
+        self._quarantine_flushed()
+        if commit:
+            self.commit()
+        return len(emitted)
+
     # ---------------------------------------------------------------- poll
     def poll(self, max_records: Optional[int] = None, commit: bool = True) -> int:
         """Consume available records from every source topic, in offset
@@ -310,6 +324,11 @@ class LogDriver:
                         break
             if budget is not None and budget <= 0:
                 break
+        # Event-time wall tick (ISSUE 10): idle-source watermark timeouts
+        # advance at poll cadence, so a stalled exchange stops holding the
+        # merged watermark (and its buffered records) back. No-op for
+        # topologies without an event-time gate.
+        self.topology.tick_event_time(int(time.time() * 1000))
         self.topology.flush()  # flush device micro-batches
         self._quarantine_flushed()
         if commit and processed:

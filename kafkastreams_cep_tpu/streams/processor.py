@@ -13,7 +13,7 @@ micro-batched device engine while keeping this store/HWM contract
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Generic, List, Optional, Tuple, TypeVar
+from typing import Any, Callable, Dict, Generic, List, Optional, Tuple, TypeVar
 
 from ..core.event import Event
 from ..core.sequence import Sequence
@@ -41,6 +41,11 @@ class CEPProcessor(Generic[K, V]):
         aggregates: Optional[AggregatesStore] = None,
         strict_windows: bool = False,
         registry: Optional[Any] = None,
+        reorder_capacity: int = 0,
+        lateness_ms: int = 0,
+        late_policy: str = "drop",
+        reorder_overflow: str = "drop",
+        watermark_gen: Optional[Any] = None,
     ) -> None:
         from ..obs.registry import default_registry
 
@@ -79,6 +84,38 @@ class CEPProcessor(Generic[K, V]):
             "the driver quarantines them to the DLQ)",
             labels=("query",),
         ).labels(query=self.query_name)
+        # Event-time gate (ISSUE 10): with reorder_capacity > 0 arriving
+        # records route through a bounded per-key reorder buffer and the
+        # match loop runs on the watermark's event-time-ordered releases.
+        # The host NFA's expiry clock is each record's own timestamp, so
+        # the released (sorted) stream gives reference-exact event-time
+        # semantics; `recompute-none` late admissions process at their raw
+        # (older) timestamp -- the documented best-effort mode.
+        self.gate = None
+        #: Arrival-side HWM for the gated mode: IN-MEMORY on purpose. A
+        #: record below the mark was already offered to the gate, so the
+        #: mark must live and die with the gate contents it guards --
+        #: both checkpoint atomically (event_time_state / the event-time
+        #: changelog store), never through the per-record nfa_store
+        #: offsets, whose changelog would make the mark durable while the
+        #: buffered record it covers evaporates on crash.
+        self._arrival_hwm: Dict[Tuple[Any, str], int] = {}
+        self._et_opts = dict(
+            reorder_capacity=reorder_capacity, lateness_ms=lateness_ms,
+            late_policy=late_policy, reorder_overflow=reorder_overflow,
+        )
+        if reorder_capacity > 0:
+            from ..time import EventTimeGate
+
+            self.gate = EventTimeGate(
+                capacity=reorder_capacity,
+                lateness_ms=lateness_ms,
+                late_policy=late_policy,
+                on_overflow=reorder_overflow,
+                generator=watermark_gen,
+                registry=self.metrics,
+                query_name=self.query_name,
+            )
 
     def _load_nfa(self, key: K) -> Tuple[NFA, NFAStates]:
         snapshot = self.nfa_store.find(key)
@@ -108,22 +145,105 @@ class CEPProcessor(Generic[K, V]):
         partition: int = 0,
         offset: int = 0,
     ) -> List[Sequence[K, V]]:
-        """Process one record; returns completed matches for this key."""
+        """Process one record; returns completed matches for this key.
+
+        With an event-time gate armed, the arriving record is deduped (and
+        its high-water mark advanced) at ARRIVAL, then buffered; the match
+        loop runs on whatever the watermark released -- possibly other
+        keys' earlier records, possibly nothing yet."""
         if key is None or value is None:
             return []
-        nfa, snapshot = self._load_nfa(key)
+        event = Event(key, value, timestamp, topic, partition, offset)
+        if self.gate is None:
+            return self._process_event(event)
+        return [seq for _k, seq in self._process_gated(event)]
+
+    def process_keyed(
+        self,
+        key: K,
+        value: V,
+        timestamp: int = 0,
+        topic: str = "",
+        partition: int = 0,
+        offset: int = 0,
+    ) -> List[Tuple[K, Sequence[K, V]]]:
+        """Like process(), but every match carries ITS OWN key. With an
+        event-time gate armed, one arriving record can release OTHER
+        keys' buffered records -- the topology must attribute those
+        matches (sink keys, emission-dedup digests) to the key that
+        matched, never to the arrival that triggered the release."""
+        if key is None or value is None:
+            return []
+        event = Event(key, value, timestamp, topic, partition, offset)
+        if self.gate is None:
+            return [(key, s) for s in self._process_event(event)]
+        return self._process_gated(event)
+
+    def _process_gated(self, event: Event) -> List[Tuple[K, Sequence[K, V]]]:
+        if self._arrival_below_hwm(event):
+            self._m_skipped.inc()
+            return []
+        # Admission first (may raise CEPOverflowError under
+        # on_overflow="raise" -- the HWM must stay untouched so a retry
+        # of the rejected record is not deduped as a replay), THEN the
+        # durable arrival mark, then the released records' match loops.
+        released = self.gate.offer(event)
+        self._advance_arrival_hwm(event)
+        out: List[Tuple[K, Sequence[K, V]]] = []
+        for ev, _clk in released:
+            out.extend(
+                (ev.key, s) for s in self._process_event(ev, check_hwm=False)
+            )
+        return out
+
+    def _arrival_below_hwm(self, event: Event) -> bool:
+        """Arrival-side HWM dedup (gate armed): released records were
+        already deduped here, so the match loop skips the re-check -- the
+        release-side mark would otherwise reject every buffered record
+        behind its own arrival."""
+        latest = self._arrival_hwm.get(
+            (event.key, f"{event.topic}#{event.partition}")
+        )
+        return latest is not None and event.offset < latest
+
+    def _advance_arrival_hwm(self, event: Event) -> None:
+        """Advance the arrival mark AFTER gate admission succeeded (a
+        CEPOverflowError rejection must leave it untouched, or the retry
+        would be deduped as a replay)."""
+        self._arrival_hwm[
+            (event.key, f"{event.topic}#{event.partition}")
+        ] = event.offset + 1
+
+    def event_time_state(self) -> Dict[str, Any]:
+        """Gate contents + arrival marks as ONE state dict: the two are
+        meaningless apart (a durable mark over lost buffer contents is a
+        silent record loss), so every durability surface -- snapshot()
+        and the event-time changelog store -- carries them together."""
+        state = self.gate.snapshot_state()
+        state["hwm"] = dict(self._arrival_hwm)
+        return state
+
+    def restore_event_time(self, state: Dict[str, Any]) -> None:
+        self.gate.restore_state(state)
+        self._arrival_hwm = dict(state.get("hwm", {}))
+
+    def _process_event(
+        self, event: Event, check_hwm: bool = True
+    ) -> List[Sequence[K, V]]:
+        nfa, snapshot = self._load_nfa(event.key)
 
         # The reference keys the HWM by topic only because each of its
         # processor tasks owns exactly one partition; here one processor may
         # see every partition, so the mark is per (topic, partition).
-        hwm_key = f"{topic}#{partition}"
-        latest = snapshot.latest_offset_for_topic(hwm_key)
-        if latest is not None and offset < latest:
-            # Replayed record below the high-water mark: at-least-once dedup.
-            self._m_skipped.inc()
-            return []
+        hwm_key = f"{event.topic}#{event.partition}"
+        if check_hwm:
+            latest = snapshot.latest_offset_for_topic(hwm_key)
+            if latest is not None and event.offset < latest:
+                # Replayed record below the high-water mark: at-least-once
+                # dedup.
+                self._m_skipped.inc()
+                return []
 
-        event = Event(key, value, timestamp, topic, partition, offset)
         try:
             sequences = nfa.match_pattern(event)
         except Exception:
@@ -139,26 +259,68 @@ class CEPProcessor(Generic[K, V]):
             self._m_matches.inc(len(sequences))
 
         offsets = dict(snapshot.latest_offsets)
-        offsets[hwm_key] = offset + 1
+        if check_hwm:
+            offsets[hwm_key] = event.offset + 1
         self.nfa_store.put(
-            key, NFAStates(list(nfa.computation_stages), nfa.runs, offsets)
+            event.key,
+            NFAStates(list(nfa.computation_stages), nfa.runs, offsets),
         )
         # Re-put the key's buffer so a change-logging backing captures this
         # record's in-place chain mutations (CEPProcessor.java:144-147
         # persists all three stores every record).
-        self.buffer.persist(key)
+        self.buffer.persist(event.key)
         return sequences
+
+    # ---------------------------------------------------------- event time
+    def tick_event_time(self, now_ms: int) -> List[Tuple[K, Sequence[K, V]]]:
+        """Wall-clock tick (idle-source watermarks); returns [(key, seq)]
+        for matches the released records completed."""
+        if self.gate is None:
+            return []
+        out: List[Tuple[K, Sequence[K, V]]] = []
+        for ev, _clk in self.gate.advance_wall(now_ms):
+            out.extend(
+                (ev.key, s) for s in self._process_event(ev, check_hwm=False)
+            )
+        return out
+
+    def flush_event_time(self) -> List[Tuple[K, Sequence[K, V]]]:
+        """End-of-stream: run the match loop over every buffered record in
+        event-time order."""
+        if self.gate is None:
+            return []
+        out: List[Tuple[K, Sequence[K, V]]] = []
+        for ev, _clk in self.gate.flush():
+            out.extend(
+                (ev.key, s) for s in self._process_event(ev, check_hwm=False)
+            )
+        return out
+
+    def take_late(self) -> List[Event]:
+        """Drain the gate's late side output (late_policy=sideoutput)."""
+        return self.gate.take_late() if self.gate is not None else []
 
     # --------------------------------------------------------- checkpointing
     def snapshot(self) -> bytes:
         """Bytes-level checkpoint of the query's three stores (the changelog
-        write, reference: CEPProcessor.java:144-147 + store serdes)."""
-        from ..state.serde import CheckpointCodec
+        write, reference: CEPProcessor.java:144-147 + store serdes). With
+        an event-time gate armed, the gate's reorder buffers + watermark
+        state ride a wrapper frame (state/serde.wrap_event_time)."""
+        from ..state.serde import (
+            CheckpointCodec,
+            encode_event_time_state,
+            wrap_event_time,
+        )
 
         codec = CheckpointCodec(self.stages, strict_windows=self.strict_windows)
-        return codec.encode_query_stores(
+        data = codec.encode_query_stores(
             self.nfa_store, self.buffer, self.aggregates
         )
+        if self.gate is not None:
+            data = wrap_event_time(
+                data, encode_event_time_state(self.event_time_state())
+            )
+        return data
 
     @classmethod
     def restore(
@@ -167,16 +329,36 @@ class CEPProcessor(Generic[K, V]):
         pattern_or_stages: Any,
         data: bytes,
         strict_windows: bool = False,
+        **et_opts: Any,
     ) -> "CEPProcessor":
         """Rebuild a processor from `snapshot()` bytes in a fresh object
         graph: the pattern is recompiled and run-queue stages re-linked by
-        id (ComputationStageSerde.java:56-101)."""
-        from ..state.serde import CheckpointCodec
+        id (ComputationStageSerde.java:56-101). Event-time knobs
+        (reorder_capacity, lateness_ms, late_policy, reorder_overflow,
+        watermark_gen) must match the snapshotting processor's for the
+        gate state to restore."""
+        from ..state.serde import (
+            CheckpointCodec,
+            decode_event_time_state,
+            split_event_time,
+        )
 
-        proc = cls(query_name, pattern_or_stages, strict_windows=strict_windows)
+        data, gate_bytes = split_event_time(data)
+        proc = cls(
+            query_name, pattern_or_stages, strict_windows=strict_windows,
+            **et_opts,
+        )
+        if gate_bytes is not None and proc.gate is None:
+            raise ValueError(
+                "checkpoint carries event-time gate state but the restored "
+                "processor has no gate; pass the original reorder_capacity "
+                "(and friends) to restore()"
+            )
         codec = CheckpointCodec(proc.stages, strict_windows=strict_windows)
         nfa_store, buffers, aggregates = codec.decode_query_stores(data)
         proc.nfa_store = nfa_store
         proc.buffer = buffers
         proc.aggregates = aggregates
+        if gate_bytes is not None:
+            proc.restore_event_time(decode_event_time_state(gate_bytes))
         return proc

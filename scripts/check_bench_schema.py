@@ -46,9 +46,30 @@ TOP_LEVEL: Dict[str, Tuple[bool, tuple]] = {
     "latency": (True, (dict, type(None))),
     "observation": (True, (dict,)),
     "metrics_merged": (True, (dict, type(None))),
+    "watermark": (True, (dict, type(None))),
     "compile": (True, (dict,)),
     "regression": (True, (dict, type(None))),
     "schema_ok": (False, (bool,)),
+}
+
+#: The `watermark` block (ISSUE 10): the event-time pass's reorder-stage
+#: overhead vs. the in-order baseline and watermark-lag percentiles; None
+#: when the skip_any8 family did not run.
+WATERMARK_KEYS: Dict[str, tuple] = {
+    "inorder_eps": (int, float),
+    "reorder_eps": (int, float),
+    "overhead_pct": (int, float, type(None)),
+    "lag_p50_ms": (int, float),
+    "lag_p99_ms": (int, float),
+    "released": (int, float),
+    "late_dropped": (int, float),
+    "occupancy_peak": (int, float),
+    "inorder_matches": (int, float),
+    "reorder_matches": (int, float),
+    "n_expired_inorder": (int, float),
+    "n_expired_reorder": (int, float),
+    "keys": (int, float),
+    "batch": (int, float),
 }
 
 #: The `observation` block (ISSUE 7): what telemetry was armed while the
@@ -123,6 +144,8 @@ FAULT_KEYS = (
     "cep_driver_restore_failures_total",
     "cep_checkpoint_corrupt_total",
     "cep_emit_deduped_total",
+    "cep_late_dropped_total",
+    "cep_reorder_overflow_dropped_total",
 )
 
 #: The per-component breakdown (ops/profiling.py BatchTimings.components):
@@ -305,6 +328,10 @@ def validate(out: Any) -> List[str]:
         )
     if isinstance(out.get("latency"), (dict, type(None))):
         _check_flat_block(out.get("latency"), LATENCY_KEYS, "latency", errors)
+    if isinstance(out.get("watermark"), (dict, type(None))):
+        _check_flat_block(
+            out.get("watermark"), WATERMARK_KEYS, "watermark", errors
+        )
     compile_block = out.get("compile")
     if isinstance(compile_block, dict):
         _check_flat_block(compile_block, COMPILE_KEYS, "compile", errors)

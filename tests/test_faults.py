@@ -603,3 +603,143 @@ def test_schedule_seeding_is_deterministic():
         (p.site, p.hit) for p in b.points
     ]
     assert all(p.site in ALL_SITES for p in a.points)
+
+
+# ------------------------------------------------- reorder overflow (ISSUE 10)
+#: The `time.reorder_overflow` fault point fires inside EventTimeGate.offer
+#: and forces the admission path to treat the reorder buffer as full NOW,
+#: so seeded schedules exercise the overflow policy without filling a
+#: buffer. Contract: "raise" and "block" lose NOTHING (loud exception /
+#: counted backpressure), "drop" loses exactly the forced admissions and
+#: counts them in cep_reorder_overflow_dropped_total.
+from kafkastreams_cep_tpu.obs.registry import MetricsRegistry as _Reg
+from kafkastreams_cep_tpu.time import EventTimeGate
+
+
+def _overflow_run(schedule, policy, n=24):
+    from kafkastreams_cep_tpu.core.event import Event
+
+    reg = _Reg()
+    gate = EventTimeGate(
+        capacity=64, lateness_ms=10_000, on_overflow=policy,
+        registry=reg, query_name="chaos",
+    )
+    released = []
+    raised = 0
+    with armed(FaultInjector(schedule, registry=reg)):
+        for i in range(n):
+            e = Event("K", f"e{i}", 1000 + i, "t", 0, i)
+            while True:
+                try:
+                    released.extend(gate.offer(e))
+                    break
+                except CEPOverflowError:
+                    # the caller's backoff-and-retry loop: the buffer lost
+                    # nothing, so the retry admits (unless the NEXT hit is
+                    # also scheduled -- keep retrying).
+                    raised += 1
+    released.extend(gate.flush())
+
+    def total(name):
+        fam = reg.snapshot().get(name)
+        return int(sum(v["value"] for v in fam["values"])) if fam else 0
+
+    n_fired = len([p for p in schedule.points if p.fired])
+    return released, raised, n_fired, total
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_reorder_overflow_raise_loses_nothing(seed):
+    schedule = FaultSchedule.seeded(
+        seed, sites=("time.reorder_overflow",), n_points=3, max_hit=20
+    )
+    released, raised, n_fired, total = _overflow_run(schedule, "raise")
+    assert n_fired >= 1, "seeded schedule must bite"
+    assert raised == n_fired  # every forced overflow surfaced loudly
+    assert len(released) == 24  # ...and nothing was lost
+    assert [e.timestamp for e, _ in released] == sorted(
+        e.timestamp for e, _ in released
+    )
+    assert total("cep_reorder_overflow_dropped_total") == 0
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_reorder_overflow_block_loses_nothing(seed):
+    schedule = FaultSchedule.seeded(
+        seed, sites=("time.reorder_overflow",), n_points=3, max_hit=20
+    )
+    released, raised, n_fired, total = _overflow_run(schedule, "block")
+    assert n_fired >= 1
+    assert raised == 0  # backpressure, not escalation
+    assert len(released) == 24  # forced early releases, zero loss
+    assert [e.timestamp for e, _ in released] == sorted(
+        e.timestamp for e, _ in released
+    )  # forced releases preserve event-time order
+    # every fire with a non-empty buffer forced one release; only a
+    # hit landing on the very first admission finds it empty.
+    assert total("cep_reorder_backpressure_total") >= n_fired - 1
+    assert total("cep_reorder_overflow_dropped_total") == 0
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_reorder_overflow_drop_is_loud(seed):
+    schedule = FaultSchedule.seeded(
+        seed, sites=("time.reorder_overflow",), n_points=3, max_hit=20
+    )
+    released, raised, n_fired, total = _overflow_run(schedule, "drop")
+    assert n_fired >= 1
+    assert raised == 0
+    # exactly the forced admissions are lost -- and counted, never silent
+    assert len(released) == 24 - n_fired
+    assert total("cep_reorder_overflow_dropped_total") == n_fired
+
+
+def test_reorder_overflow_block_pipeline_digest_equal(tmp_path):
+    """Full device pipeline with a gated config under scheduled reorder
+    overflow, policy 'block': the sink stream is bitwise identical to the
+    fault-free golden run (backpressure must not lose or duplicate)."""
+    stream = _stream(13, n=24)
+    keys = ("k0", "k1")
+    gated_cfg = EngineConfig(
+        lanes=8, nodes=256, matches=256, matches_per_step=4,
+        nodes_per_step=8, on_overflow="block",
+        reorder_capacity=32, lateness_ms=2,
+    )
+    opts = dict(DEVICE_OPTS, config=gated_cfg)
+
+    def _golden_gated():
+        # local golden: the gate buffers a lateness tail, so the fault-free
+        # reference run needs the same end-of-stream drain the chaos run
+        # gets (the shared _golden helper stops at the last empty poll).
+        log = RecordLog()
+        for i, ch in enumerate(stream):
+            produce(log, "letters", keys[(i // 6) % len(keys)], ch,
+                    timestamp=i)
+        topo, _out = _build(log, runtime="tpu", **opts)
+        driver = LogDriver(topo, group="g")
+        while driver.poll(max_records=4):
+            pass
+        driver.drain_event_time()
+        return _sink_digests(log)
+
+    golden = _golden_gated()
+    assert golden, "gated golden run must produce matches"
+
+    schedule = FaultSchedule(
+        [FaultPoint("time.reorder_overflow", h) for h in (2, 5, 11)]
+    )
+    path = str(tmp_path / "wal")
+    log = RecordLog(path)
+    for i, ch in enumerate(stream):
+        produce(log, "letters", keys[(i // 6) % len(keys)], ch, timestamp=i)
+    log.flush()
+    registry = MetricsRegistry()
+    with armed(FaultInjector(schedule, registry=registry)):
+        topo, _out = _build(log, runtime="tpu", registry=registry, **opts)
+        driver = LogDriver(topo, group="g")
+        while driver.poll(max_records=4):
+            pass
+        driver.drain_event_time()
+    assert all(p.fired for p in schedule.points)
+    _assert_stream_equal(golden, _sink_digests(log))
+    log.close()

@@ -495,6 +495,23 @@ def _valid_artifact():
             "profilez_armed": True,
         },
         "metrics_merged": reg.snapshot(),
+        # ISSUE 10: the event-time pass's reorder-overhead block.
+        "watermark": {
+            "inorder_eps": 1000.0,
+            "reorder_eps": 950.0,
+            "overhead_pct": 5.0,
+            "lag_p50_ms": 6.0,
+            "lag_p99_ms": 6.0,
+            "released": 128,
+            "late_dropped": 0,
+            "occupancy_peak": 4,
+            "inorder_matches": 7,
+            "reorder_matches": 7,
+            "n_expired_inorder": 10,
+            "n_expired_reorder": 10,
+            "keys": 8,
+            "batch": 16,
+        },
         # ISSUE 9: compile telemetry + regression verdict blocks.
         "compile": {
             "fns": {

@@ -1,0 +1,1166 @@
+"""Event-time subsystem (ISSUE 10): reorder buffer, watermarks, late data.
+
+Pins the subsystem's three contracts:
+
+  * ARRIVAL PARITY -- with a watermark tracking arrival order (per-record
+    clocks equal to the record timestamps), engine state and output are
+    BITWISE identical to running without any watermark (the historical
+    arrival-order expiry);
+  * REORDER DIFFERENTIAL -- an out-of-order stream driven through the
+    gate into the device engine (watermark clocks threaded) produces the
+    same matches as the host oracle fed the pre-sorted stream, across
+    xla + pallas_interpret x flat + pool drain modes, with zero late
+    drops inside the lateness bound;
+  * LATE/OVERFLOW POLICY -- late-drop counts pin per policy, and the
+    reorder buffer's overflow path honors EngineConfig.on_overflow.
+
+Plus: watermark-driven expiry (n_expired sweeps past idle gaps), serde
+round-trips (gate state, wrapper frames, legacy passthrough), processor
+crash/restore consistency, the two new model workloads, and the
+Sequence.provenance event-time window-span fix.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu import (
+    AggregatesStore,
+    Event,
+    NFA,
+    QueryBuilder,
+    Selected,
+    SharedVersionedBuffer,
+    compile_pattern,
+)
+from kafkastreams_cep_tpu.obs.registry import MetricsRegistry
+from kafkastreams_cep_tpu.ops.engine import EngineConfig
+from kafkastreams_cep_tpu.ops.runtime import DeviceNFA
+from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA
+from kafkastreams_cep_tpu.pattern.expressions import value
+from kafkastreams_cep_tpu.time import (
+    ArrivalOrderWatermark,
+    BoundedOutOfOrderness,
+    EventTimeGate,
+    IdleTimeout,
+    MinMergeWatermark,
+    ReorderBuffer,
+)
+from kafkastreams_cep_tpu.time.watermarks import WM_MIN_MS
+
+TS = 1_000_000
+
+
+def ev(v, ts, key="K", topic="t", partition=0, offset=0):
+    return Event(key, v, ts, topic, partition, offset)
+
+
+def abc_pattern(window_ms=None):
+    qb = QueryBuilder().select("a").where(value() == "A")
+    if window_ms:
+        qb = qb.within(ms=window_ms)
+    b = qb.then().select("b").where(value() == "B")
+    if window_ms:
+        b = b.within(ms=window_ms)
+    c = b.then().select("c").where(value() == "C")
+    if window_ms:
+        c = c.within(ms=window_ms)
+    return c.build()
+
+
+def skipany_pattern(window_ms=16):
+    return (
+        QueryBuilder()
+        .select("a").where(value() == "A").within(ms=window_ms)
+        .then()
+        .select("b", Selected.with_skip_til_any_match())
+        .where(value() == "B").within(ms=window_ms)
+        .then()
+        .select("c", Selected.with_skip_til_next_match())
+        .where(value() == "C").within(ms=window_ms)
+        .build()
+    )
+
+
+def bounded_shuffle(events, bound_ms, seed=7):
+    """Displace arrival order by at most `bound_ms` of event time.
+
+    Offsets renumber by ARRIVAL position: a log assigns offsets at append
+    time, so arrival order == offset order per partition even when event
+    time interleaves -- the exact contract the subsystem models."""
+    import dataclasses
+
+    rng = random.Random(seed)
+    order = sorted(
+        range(len(events)),
+        key=lambda i: (events[i].timestamp + rng.randint(0, bound_ms), i),
+    )
+    return [
+        dataclasses.replace(events[i], offset=pos)
+        for pos, i in enumerate(order)
+    ]
+
+
+def sorted_feed(events):
+    """The oracle feed: stable event-time sort of the SAME Event objects."""
+    return sorted(enumerate(events), key=lambda ie: (ie[1].timestamp, ie[0]))
+
+
+# ---------------------------------------------------------------------------
+# ReorderBuffer
+# ---------------------------------------------------------------------------
+def test_reorder_buffer_releases_in_event_time_order():
+    buf = ReorderBuffer(capacity=8)
+    for i, (v, ts) in enumerate([("a", 5), ("b", 3), ("c", 9), ("d", 3)]):
+        buf.push(ev(v, ts, offset=i), seq=i)
+    assert len(buf) == 4 and buf.peek_ts() == 3
+    out = buf.release(5)
+    # ties (ts=3) release in arrival order: b (seq 1) before d (seq 3)
+    assert [e.value for _s, e in out] == ["b", "d", "a"]
+    assert [e.value for _s, e in buf.drain()] == ["c"]
+    assert len(buf) == 0
+
+
+def test_reorder_buffer_capacity_and_forced_eviction():
+    buf = ReorderBuffer(capacity=2)
+    buf.push(ev("a", 10), 0)
+    assert not buf.full
+    buf.push(ev("b", 4), 1)
+    assert buf.full
+    ts, _seq, oldest = buf.pop_oldest()
+    assert (ts, oldest.value) == (4, "b")
+    with pytest.raises(ValueError):
+        ReorderBuffer(capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# Watermark generators
+# ---------------------------------------------------------------------------
+def test_arrival_and_bounded_generators():
+    a = ArrivalOrderWatermark()
+    assert a.current_ms() == WM_MIN_MS
+    a.observe(100)
+    a.observe(90)  # regression never lowers the mark
+    assert a.current_ms() == 100
+
+    b = BoundedOutOfOrderness(25)
+    assert b.current_ms() == WM_MIN_MS  # no observation yet: no watermark
+    b.observe(1000)
+    assert b.current_ms() == 975
+    b.observe(900)
+    assert b.current_ms() == 975
+
+
+def test_min_merge_holds_for_slow_source_until_idle():
+    gen = MinMergeWatermark(
+        default_factory=lambda: BoundedOutOfOrderness(0)
+    )
+    gen.observe(1000, source="fast")
+    gen.observe(400, source="slow")
+    assert gen.current_ms() == 400  # slow source holds the merge back
+    gen.mark_idle("slow")
+    assert gen.current_ms() == 1000  # idle source stops holding
+    gen.observe(500, source="slow")  # waking up rejoins the min
+    assert gen.current_ms() == 500
+
+
+def test_idle_timeout_advances_on_wall_silence():
+    gen = IdleTimeout(BoundedOutOfOrderness(50), timeout_ms=100)
+    gen.advance_wall(0)
+    gen.observe(1000)
+    assert gen.current_ms() == 950
+    gen.advance_wall(50)
+    assert not gen.is_idle
+    gen.advance_wall(150)  # silent past the timeout
+    assert gen.is_idle
+    # watermark jumps to the max OBSERVED event time: the source is
+    # provably stalled, nothing older than 1000 is coming from it.
+    assert gen.current_ms() == 1000
+    gen.observe(1010)
+    assert not gen.is_idle and gen.current_ms() == 960
+
+
+# ---------------------------------------------------------------------------
+# EventTimeGate
+# ---------------------------------------------------------------------------
+def test_gate_releases_sorted_with_own_ts_clocks():
+    gate = EventTimeGate(
+        capacity=16, lateness_ms=10, registry=MetricsRegistry()
+    )
+    events = [ev(v, ts, offset=i) for i, (v, ts) in enumerate(
+        [("a", 100), ("b", 103), ("c", 101), ("d", 120), ("e", 111)]
+    )]
+    rel = []
+    for e in events:
+        rel.extend(gate.offer(e))
+    rel.extend(gate.flush())
+    assert [e.timestamp for e, _ in rel] == sorted(e.timestamp for e in events)
+    # normal-path clocks equal each record's own timestamp (the monotone
+    # event-time clock over a sorted release stream)
+    assert all(clk == e.timestamp for e, clk in rel)
+    assert gate.occupancy == 0
+
+
+@pytest.mark.parametrize("policy", ["drop", "sideoutput", "recompute-none"])
+def test_gate_late_policy_counts_pinned(policy):
+    reg = MetricsRegistry()
+    gate = EventTimeGate(
+        capacity=16, lateness_ms=0, late_policy=policy,
+        generator=ArrivalOrderWatermark(), registry=reg, query_name="q",
+    )
+    out = []
+    out += gate.offer(ev("a", 100, offset=0))
+    out += gate.offer(ev("late", 40, offset=1))   # 60 ms behind the mark
+    out += gate.offer(ev("b", 110, offset=2))
+    out += gate.flush()
+
+    def total(name):
+        fam = reg.snapshot().get(name)
+        return sum(v["value"] for v in fam["values"]) if fam else 0
+
+    if policy == "drop":
+        assert [e.value for e, _ in out] == ["a", "b"]
+        assert total("cep_late_dropped_total") == 1
+        assert gate.take_late() == []
+    elif policy == "sideoutput":
+        assert [e.value for e, _ in out] == ["a", "b"]
+        assert total("cep_late_sideoutput_total") == 1
+        assert [e.value for e in gate.take_late()] == ["late"]
+    else:
+        assert [e.value for e, _ in out] == ["a", "late", "b"]
+        assert total("cep_late_admitted_total") == 1
+        # the admitted record carries the CLAMPED clock (never rewinds)
+        late_clk = [clk for e, clk in out if e.value == "late"][0]
+        assert late_clk >= 100
+
+
+def test_gate_overflow_drop_is_loud():
+    reg = MetricsRegistry()
+    gate = EventTimeGate(
+        capacity=2, lateness_ms=1000, registry=reg, query_name="q"
+    )
+    for i, ts in enumerate((100, 101, 102, 103)):  # capacity 2: 2 overflow
+        gate.offer(ev(f"e{i}", ts, offset=i))
+    out = gate.flush()
+    fam = reg.snapshot()["cep_reorder_overflow_dropped_total"]
+    assert sum(v["value"] for v in fam["values"]) == 2
+    assert len(out) == 2  # the admitted two; the drops are loud, not silent
+
+
+def test_gate_overflow_raise():
+    gate = EventTimeGate(
+        capacity=1, lateness_ms=1000, on_overflow="raise",
+        registry=MetricsRegistry(),
+    )
+    from kafkastreams_cep_tpu.faults import CEPOverflowError
+
+    gate.offer(ev("a", 100, offset=0))
+    with pytest.raises(CEPOverflowError):
+        gate.offer(ev("b", 101, offset=1))
+
+
+def test_gate_overflow_block_loses_nothing():
+    reg = MetricsRegistry()
+    gate = EventTimeGate(
+        capacity=2, lateness_ms=1000, on_overflow="block",
+        registry=reg, query_name="q",
+    )
+    n = 8
+    out = []
+    for i in range(n):
+        out.extend(gate.offer(ev(f"e{i}", 100 + i, offset=i)))
+    out.extend(gate.flush())
+    assert len(out) == n  # forced releases + flush: zero loss
+    assert [e.timestamp for e, _ in out] == sorted(100 + i for i in range(n))
+    fam = reg.snapshot()["cep_reorder_backpressure_total"]
+    assert sum(v["value"] for v in fam["values"]) == n - 2
+
+
+def test_gate_snapshot_restore_roundtrip():
+    from kafkastreams_cep_tpu.state.serde import (
+        decode_event_time_state,
+        encode_event_time_state,
+        split_event_time,
+        wrap_event_time,
+    )
+
+    gate = EventTimeGate(
+        capacity=16, lateness_ms=20, registry=MetricsRegistry()
+    )
+    for i, (v, ts) in enumerate([("a", 100), ("b", 130), ("c", 118)]):
+        gate.offer(ev(v, ts, key=f"k{i % 2}", offset=i))
+    blob = encode_event_time_state(gate.snapshot_state())
+    gate2 = EventTimeGate(
+        capacity=16, lateness_ms=20, registry=MetricsRegistry()
+    )
+    gate2.restore_state(decode_event_time_state(blob))
+    assert gate2.watermark_ms == gate.watermark_ms
+    assert gate2.occupancy == gate.occupancy
+    a = [(e.value, clk) for e, clk in gate.flush()]
+    b = [(e.value, clk) for e, clk in gate2.flush()]
+    assert a == b
+
+    # generator-kind mismatch refuses loudly
+    gate3 = EventTimeGate(
+        capacity=16, generator=ArrivalOrderWatermark(),
+        registry=MetricsRegistry(),
+    )
+    with pytest.raises(ValueError):
+        gate3.restore_state(decode_event_time_state(blob))
+
+    # wrapper: tagged frames split, legacy frames pass through
+    from kafkastreams_cep_tpu.state.serde import seal_frame, MAGIC
+
+    legacy = seal_frame(MAGIC + b"payload")
+    assert split_event_time(legacy) == (legacy, None)
+    wrapped = wrap_event_time(legacy, blob)
+    inner, gb = split_event_time(wrapped)
+    assert inner == legacy and gb == blob
+
+
+# ---------------------------------------------------------------------------
+# Engine: arrival parity + watermark-driven expiry
+# ---------------------------------------------------------------------------
+def in_order_stream(n=48, seed=3):
+    rng = random.Random(seed)
+    ts = TS
+    out = []
+    for i in range(n):
+        ts += rng.choice((0, 1, 1, 2, 7))
+        out.append(ev(rng.choice("ABCX"), ts, offset=i))
+    return out
+
+
+def test_single_key_arrival_watermark_bitwise_pin():
+    stream = in_order_stream()
+    cfg = EngineConfig(lanes=32, nodes=512, matches=64, strict_windows=True)
+    d_plain = DeviceNFA(compile_pattern(skipany_pattern()), config=cfg)
+    d_wm = DeviceNFA(compile_pattern(skipany_pattern()), config=cfg)
+    m_plain, m_wm = [], []
+    for i in range(0, len(stream), 12):
+        chunk = stream[i:i + 12]
+        m_plain.extend(d_plain.advance(chunk))
+        # arrival-order watermark: per-record clocks == own timestamps
+        m_wm.extend(
+            d_wm.advance(chunk, watermark_ms=[e.timestamp for e in chunk])
+        )
+    assert m_plain == m_wm
+    for k in d_plain.state:
+        assert (
+            np.asarray(d_plain.state[k]) == np.asarray(d_wm.state[k])
+        ).all(), f"state[{k}] diverged under an arrival-tracking watermark"
+    for k in d_plain.pool:
+        assert (
+            np.asarray(d_plain.pool[k]) == np.asarray(d_wm.pool[k])
+        ).all(), f"pool[{k}] diverged under an arrival-tracking watermark"
+
+
+@pytest.mark.parametrize("engine", ["xla", "pallas_interpret"])
+def test_batched_arrival_watermark_bitwise_pin(engine):
+    keys = [f"k{i}" for i in range(8)]
+    rng = random.Random(11)
+    streams = {
+        k: [ev(rng.choice("ABCX"), TS + j, key=k, offset=j) for j in range(24)]
+        for k in keys
+    }
+    cfg = EngineConfig(lanes=32, nodes=512, matches=64, strict_windows=True)
+
+    def run(with_wm):
+        bat = BatchedDeviceNFA(
+            compile_pattern(skipany_pattern()), keys=keys, config=cfg,
+            engine=engine,
+        )
+        out = {}
+        for b in range(0, 24, 8):
+            chunk = {k: s[b:b + 8] for k, s in streams.items()}
+            wms = (
+                {k: [e.timestamp for e in evs] for k, evs in chunk.items()}
+                if with_wm else None
+            )
+            for k, seqs in bat.advance(chunk, watermarks=wms).items():
+                out.setdefault(k, []).extend(seqs)
+        return out, {k: np.asarray(v) for k, v in bat.state.items()}
+
+    out_plain, st_plain = run(False)
+    out_wm, st_wm = run(True)
+    assert out_plain == out_wm
+    for k in st_plain:
+        assert (st_plain[k] == st_wm[k]).all(), k
+
+
+def test_watermark_drives_expiry_past_idle_gap():
+    """An idle-advanced watermark expires runs that per-event arrival
+    clocks would keep alive: n_expired sweeps off event time."""
+    cfg = EngineConfig(lanes=16, nodes=256, matches=32, strict_windows=True)
+    pat = compile_pattern(abc_pattern(window_ms=5))
+
+    d_wm = DeviceNFA(pat, config=cfg)
+    d_wm.advance([ev("A", TS, offset=0), ev("B", TS + 1, offset=1)])
+    # watermark says event time reached TS+50 (e.g. idle-source timeout):
+    # the open run's 5 ms window is provably expired even though the
+    # record itself carries an old-looking timestamp.
+    d_wm.advance([ev("X", TS + 2, offset=2)], watermark_ms=TS + 50)
+
+    d_plain = DeviceNFA(pat, config=cfg)
+    d_plain.advance([ev("A", TS, offset=0), ev("B", TS + 1, offset=1)])
+    d_plain.advance([ev("X", TS + 2, offset=2)])
+
+    assert d_wm.stats["n_expired"] > d_plain.stats["n_expired"]
+
+
+# ---------------------------------------------------------------------------
+# Reorder differential vs. the host oracle on the sorted stream
+# ---------------------------------------------------------------------------
+BOUND_MS = 6
+
+
+def oracle_matches(pattern, events_sorted, strict_windows=True):
+    stages = compile_pattern(pattern)
+    nfa = NFA.build(
+        stages, AggregatesStore(), SharedVersionedBuffer(),
+        strict_windows=strict_windows,
+    )
+    out = []
+    for e in events_sorted:
+        out.extend(nfa.match_pattern(e))
+    return out
+
+
+@pytest.mark.parametrize("engine", ["xla", "pallas_interpret"])
+@pytest.mark.parametrize("drain_mode", ["flat", "pool"])
+def test_reorder_differential_vs_sorted_oracle(engine, drain_mode):
+    keys = [f"k{i}" for i in range(8)]
+    rng = random.Random(29)
+    per_key = {}
+    for k in keys:
+        ts = TS
+        evs = []
+        for i in range(30):
+            ts += rng.choice((0, 1, 1, 2, 5))
+            evs.append(ev(rng.choice("ABCX"), ts, key=k, offset=i))
+        # NB: a str hash here would be PYTHONHASHSEED-randomized and make
+        # the differential seeds run-dependent.
+        per_key[k] = bounded_shuffle(evs, BOUND_MS, seed=1000 + int(k[1:]))
+
+    # Sized for ZERO drop counters on these seeds: skip-till-any doubling
+    # needs lane/ring headroom, and a capacity drop would read as a false
+    # differential divergence (asserted below).
+    cfg = EngineConfig(
+        lanes=128, nodes=1024, matches=2048, matches_per_step=128,
+        strict_windows=True,
+        reorder_capacity=64, lateness_ms=BOUND_MS,
+    )
+    reg = MetricsRegistry()
+    # One gate over the fan-in with a per-source min-merge watermark
+    # (source = key): each key's own bounded-out-of-orderness mark rides
+    # its own stream, so one key racing ahead in event time can never
+    # push another key's in-bound records late. Sources PRE-REGISTERED:
+    # the merge must not run ahead of a source it has not heard from yet
+    # (see MinMergeWatermark docstring).
+    gate = EventTimeGate(
+        capacity=cfg.reorder_capacity, lateness_ms=cfg.lateness_ms,
+        generator=MinMergeWatermark(
+            per_source={k: BoundedOutOfOrderness(BOUND_MS) for k in keys}
+        ),
+        registry=reg, query_name="diff",
+    )
+    bat = BatchedDeviceNFA(
+        compile_pattern(skipany_pattern()), keys=keys, config=cfg,
+        engine=engine, drain_mode=drain_mode,
+    )
+    got = {k: [] for k in keys}
+
+    def feed(released):
+        rel, wms = {}, {}
+        for e, clk in released:
+            rel.setdefault(e.key, []).append(e)
+            wms.setdefault(e.key, []).append(clk)
+        if rel:
+            for k, seqs in bat.advance(rel, watermarks=wms).items():
+                got[k].extend(seqs)
+
+    # interleave arrivals round-robin across keys (multi-source fan-in)
+    for step in range(30):
+        batch = []
+        for k in keys:
+            e = per_key[k][step]
+            batch.extend(gate.offer(e, source=e.key))
+        feed(batch)
+    feed(gate.flush())
+
+    fam = reg.snapshot().get("cep_late_dropped_total")
+    late = sum(v["value"] for v in fam["values"]) if fam else 0
+    assert late == 0, "in-bound shuffle must never go late"
+    stats = bat.stats
+    assert stats["lane_drops"] == 0 and stats["match_drops"] == 0, stats
+
+    for k in keys:
+        want = oracle_matches(
+            skipany_pattern(),
+            [e for _i, e in sorted_feed(per_key[k])],
+        )
+        assert got[k] == want, f"key {k}: reorder path diverged from oracle"
+
+
+def test_reorder_differential_single_key_device():
+    """Single-key DeviceNFA runtime through the same gate contract."""
+    rng = random.Random(5)
+    ts = TS
+    evs = []
+    for i in range(40):
+        ts += rng.choice((0, 1, 2, 4))
+        evs.append(ev(rng.choice("ABCX"), ts, offset=i))
+    arrival = bounded_shuffle(evs, BOUND_MS, seed=13)
+
+    gate = EventTimeGate(
+        capacity=64, lateness_ms=BOUND_MS, registry=MetricsRegistry()
+    )
+    dev = DeviceNFA(
+        compile_pattern(skipany_pattern()),
+        config=EngineConfig(
+            lanes=128, nodes=1024, matches=2048, matches_per_step=128,
+            strict_windows=True,
+        ),
+    )
+    got = []
+    for e in arrival:
+        rel = gate.offer(e)
+        if rel:
+            got.extend(
+                dev.advance(
+                    [r for r, _ in rel], watermark_ms=[c for _, c in rel]
+                )
+            )
+    rel = gate.flush()
+    if rel:
+        got.extend(
+            dev.advance([r for r, _ in rel], watermark_ms=[c for _, c in rel])
+        )
+    want = oracle_matches(
+        skipany_pattern(), [e for _i, e in sorted_feed(arrival)]
+    )
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# Streams layer: processors, checkpointing, models
+# ---------------------------------------------------------------------------
+def test_device_processor_gate_crash_restore_consistent():
+    from kafkastreams_cep_tpu.streams.device_processor import (
+        DeviceCEPProcessor,
+    )
+
+    rng = random.Random(3)
+    letters = "ABCABCXABCABC"
+    evts = [ev(v, TS + i, offset=i) for i, v in enumerate(letters)]
+    arrival = bounded_shuffle(evts, 4, seed=9)
+    cfg = EngineConfig(
+        lanes=16, nodes=256, matches=64,
+        reorder_capacity=32, lateness_ms=4,
+    )
+
+    def run(crash_at=None):
+        proc = DeviceCEPProcessor(
+            "q", abc_pattern(), config=cfg, batch_size=3,
+            registry=MetricsRegistry(),
+        )
+        out = []
+        for off, e in enumerate(arrival):
+            if crash_at is not None and off == crash_at:
+                snap = proc.snapshot()
+                proc = DeviceCEPProcessor.restore(
+                    "q", abc_pattern(), snap, config=cfg, batch_size=3,
+                    registry=MetricsRegistry(),
+                )
+            out.extend(
+                proc.process(e.key, e.value, timestamp=e.timestamp, offset=off)
+            )
+        out.extend(proc.flush_event_time())
+        return [(k, s) for k, s in out]
+
+    golden = run()
+    assert len(golden) > 0
+    for crash_at in (4, 8):
+        assert run(crash_at) == golden, f"crash at {crash_at} diverged"
+
+
+def test_device_processor_restore_refuses_gateless_config():
+    from kafkastreams_cep_tpu.streams.device_processor import (
+        DeviceCEPProcessor,
+    )
+
+    cfg = EngineConfig(
+        lanes=16, nodes=256, matches=64, reorder_capacity=8, lateness_ms=4
+    )
+    proc = DeviceCEPProcessor(
+        "q", abc_pattern(), config=cfg, registry=MetricsRegistry()
+    )
+    proc.process("K", "A", timestamp=TS, offset=0)
+    snap = proc.snapshot()
+    plain = EngineConfig(lanes=16, nodes=256, matches=64)
+    with pytest.raises(ValueError):
+        DeviceCEPProcessor.restore(
+            "q", abc_pattern(), snap, config=plain,
+            registry=MetricsRegistry(),
+        )
+
+
+def test_host_processor_gate_matches_sorted_oracle():
+    from kafkastreams_cep_tpu.streams.processor import CEPProcessor
+
+    letters = "ABCXABCABC"
+    evts = [ev(v, TS + i, offset=i) for i, v in enumerate(letters)]
+    arrival = bounded_shuffle(evts, 4, seed=21)
+
+    gated = CEPProcessor(
+        "q", abc_pattern(), reorder_capacity=32, lateness_ms=4,
+        registry=MetricsRegistry(),
+    )
+    got = []
+    for e in arrival:
+        got.extend(
+            gated.process(e.key, e.value, timestamp=e.timestamp,
+                          topic=e.topic, offset=e.offset)
+        )
+    got.extend(s for _k, s in gated.flush_event_time())
+
+    # the raw-NFA oracle (no HWM): the sorted feed's offsets are
+    # arrival-numbered, hence non-monotone in event-time order, and a
+    # processor oracle's offset dedup would (correctly) reject them.
+    want = oracle_matches(
+        abc_pattern(), [e for _i, e in sorted_feed(arrival)],
+        strict_windows=False,
+    )
+    assert got == want
+    assert len(want) > 0
+
+
+def test_exchanges_model_differential():
+    from kafkastreams_cep_tpu.models.exchanges import (
+        REORDER_BOUND_MS,
+        exchanges_config,
+        exchanges_pattern,
+        exchanges_schema,
+        exchanges_stream,
+    )
+    from kafkastreams_cep_tpu.streams.device_processor import (
+        DeviceCEPProcessor,
+    )
+
+    stream = exchanges_stream(random.Random(17), 120)
+    # the generator's displacement honors its advertised bound
+    run_max = stream[0].timestamp
+    for e in stream:
+        assert run_max - e.timestamp <= REORDER_BOUND_MS
+        run_max = max(run_max, e.timestamp)
+
+    cfg = exchanges_config()
+    proc = DeviceCEPProcessor(
+        "ex", exchanges_pattern(), schema=exchanges_schema(), config=cfg,
+        batch_size=16, registry=MetricsRegistry(),
+    )
+    got = []
+    for e in stream:
+        got.extend(
+            proc.process(e.key, e.value, timestamp=e.timestamp,
+                         topic=e.topic, offset=e.offset)
+        )
+    got.extend(proc.flush_event_time())
+
+    want = oracle_matches(
+        exchanges_pattern(), [e for _i, e in sorted_feed(stream)]
+    )
+    assert [s for _k, s in got] == want
+    assert len(want) > 0, "the seeded exchanges stream must produce matches"
+
+
+def test_sensors_model_idle_source_releases_on_tick():
+    from kafkastreams_cep_tpu.models.sensors import (
+        sensors_pattern,
+        sensors_schema,
+        sensors_stream,
+    )
+    from kafkastreams_cep_tpu.streams.device_processor import (
+        DeviceCEPProcessor,
+    )
+    from kafkastreams_cep_tpu.ops.engine import EngineConfig as EC
+
+    stream = sensors_stream(random.Random(7), 80)
+    assert "sensor0" in {e.topic for e in stream}  # idle source present
+
+    # min-merge per source + idle timeout: once sensor0 goes dark, a wall
+    # tick past the timeout must release the other sensors' buffer.
+    gen = IdleTimeout(
+        MinMergeWatermark(default_factory=lambda: BoundedOutOfOrderness(0)),
+        timeout_ms=100,
+    )
+    cfg = EC(
+        lanes=64, nodes=1024, matches=256, strict_windows=True,
+        reorder_capacity=256, lateness_ms=0,
+    )
+    proc = DeviceCEPProcessor(
+        "sens", sensors_pattern(), schema=sensors_schema(), config=cfg,
+        batch_size=1 << 30,  # never auto-flush: the tick must do the work
+        registry=MetricsRegistry(), watermark_gen=gen,
+    )
+    proc.gate.generator.advance_wall(0)
+    for e in stream:
+        proc.process(e.key, e.value, timestamp=e.timestamp,
+                     topic=e.topic, offset=e.offset)
+    buffered = proc.gate.occupancy
+    assert buffered > 0  # min-merge holds the tail back
+    proc.tick_event_time(10_000)  # anchors the idle clock (grace period)
+    proc.tick_event_time(10_200)  # a full timeout of real wall silence
+    assert proc.gate.occupancy < buffered, (
+        "idle timeout must release records the dark source was holding"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Provenance window span (satellite fix)
+# ---------------------------------------------------------------------------
+def test_provenance_window_span_reports_event_time():
+    from kafkastreams_cep_tpu.ops.runtime import sequence_provenance
+
+    gate = EventTimeGate(
+        capacity=32, lateness_ms=8, registry=MetricsRegistry()
+    )
+    dev = DeviceNFA(
+        compile_pattern(abc_pattern()),
+        config=EngineConfig(lanes=16, nodes=256, matches=32),
+    )
+    # event time: A@+0, B@+3, C@+6 -- but arrival (and offsets) inverted,
+    # so the Event-contract order (offset within one partition) disagrees
+    # with event time.
+    arrival = [
+        ev("C", TS + 6, offset=0),
+        ev("B", TS + 3, offset=1),
+        ev("A", TS + 0, offset=2),
+    ]
+    matches = []
+    for e in arrival:
+        rel = gate.offer(e)
+        if rel:
+            matches.extend(dev.advance(
+                [r for r, _ in rel], watermark_ms=[c for _, c in rel]
+            ))
+    rel = gate.flush()
+    if rel:
+        matches.extend(dev.advance(
+            [r for r, _ in rel], watermark_ms=[c for _, c in rel]
+        ))
+    assert len(matches) == 1
+    prov = sequence_provenance(matches[0])
+    assert prov.first_timestamp == TS       # event-time span, not the
+    assert prov.last_timestamp == TS + 6    # offset-contract span
+
+
+# ---------------------------------------------------------------------------
+# EngineConfig validation
+# ---------------------------------------------------------------------------
+def test_engine_config_event_time_validation():
+    with pytest.raises(ValueError):
+        EngineConfig(late_policy="retract")
+    with pytest.raises(ValueError):
+        EngineConfig(reorder_capacity=-1)
+    cfg = EngineConfig(reorder_capacity=8, lateness_ms=5,
+                       late_policy="sideoutput")
+    assert cfg.reorder_capacity == 8
+
+
+# ---------------------------------------------------------------------------
+# Review regressions: keyed emission, HWM-after-admission, legacy upgrade,
+# idle arming before the first wall tick
+# ---------------------------------------------------------------------------
+def test_host_topology_attributes_released_matches_to_their_key():
+    """One key's arrival releasing ANOTHER key's buffered records must
+    emit those matches under the matching key (sink identity, dedup
+    digest), never under the arrival that triggered the release."""
+    from kafkastreams_cep_tpu import ComplexStreamsBuilder
+
+    builder = ComplexStreamsBuilder()
+    out = builder.stream("letters").query(
+        "q", abc_pattern(), reorder_capacity=32, lateness_ms=4,
+        registry=MetricsRegistry(),
+    )
+    topo = builder.build()
+    # key k1 completes A->B->C entirely, out of order within the bound;
+    # the final arrival that pushes the watermark past k1's C comes from
+    # key k2.
+    arrivals = [
+        ("k1", "A", TS + 0),
+        ("k1", "C", TS + 2),   # buffered: ahead of the watermark
+        ("k1", "B", TS + 1),
+        ("k2", "X", TS + 9),   # k2's arrival releases k1's run
+    ]
+    for off, (k, v, ts) in enumerate(arrivals):
+        topo.process("letters", k, v, timestamp=ts, offset=off)
+    topo.flush_event_time()
+    assert out.records, "the released run must complete"
+    assert all(r.key == "k1" for r in out.records), [
+        (r.key, str(r.value)) for r in out.records
+    ]
+
+
+@pytest.mark.parametrize("runtime", ["host", "device"])
+def test_overflow_raise_keeps_hwm_so_retry_admits(runtime):
+    """on_overflow='raise' rejects the record LOUDLY but must not advance
+    the high-water mark: the caller's retry of the same offset has to
+    admit, not be deduped as a replay (review finding)."""
+    from kafkastreams_cep_tpu.faults import CEPOverflowError
+
+    if runtime == "host":
+        from kafkastreams_cep_tpu.streams.processor import CEPProcessor
+
+        proc = CEPProcessor(
+            "q", abc_pattern(), reorder_capacity=1, lateness_ms=1000,
+            reorder_overflow="raise", registry=MetricsRegistry(),
+        )
+    else:
+        from kafkastreams_cep_tpu.streams.device_processor import (
+            DeviceCEPProcessor,
+        )
+
+        proc = DeviceCEPProcessor(
+            "q", abc_pattern(),
+            config=EngineConfig(
+                lanes=16, nodes=256, matches=64,
+                reorder_capacity=1, lateness_ms=1000, on_overflow="raise",
+            ),
+            batch_size=64, registry=MetricsRegistry(),
+        )
+    proc.process("K", "A", timestamp=TS, offset=0)       # fills capacity 1
+    with pytest.raises(CEPOverflowError):
+        proc.process("K", "B", timestamp=TS + 1, offset=1)
+    # ...the rejected offset retries after draining the buffer:
+    proc.flush_event_time()
+    res = proc.process("K", "B", timestamp=TS + 1, offset=1)
+    # the retry was ADMITTED (not HWM-deduped): the gate has it buffered
+    assert proc.gate.occupancy == 1 or res, "retry must not be deduped"
+
+
+def test_device_processor_legacy_checkpoint_upgrades_into_gated_config():
+    """A pre-event-time snapshot (no gate frame) restored into a gated
+    config must flush its restored pending records instead of crashing on
+    the missing release clocks (review finding)."""
+    from kafkastreams_cep_tpu.streams.device_processor import (
+        DeviceCEPProcessor,
+    )
+
+    plain = EngineConfig(lanes=16, nodes=256, matches=64)
+    proc = DeviceCEPProcessor(
+        "q", abc_pattern(), config=plain, batch_size=64,
+        registry=MetricsRegistry(),
+    )
+    for off, v in enumerate("AB"):
+        proc.process("K", v, timestamp=TS + off, offset=off)
+    snap = proc.snapshot()  # ungated snapshot with 2 pending records
+
+    gated = EngineConfig(
+        lanes=16, nodes=256, matches=64, reorder_capacity=8, lateness_ms=4
+    )
+    proc2 = DeviceCEPProcessor.restore(
+        "q", abc_pattern(), snap, config=gated, batch_size=64,
+        registry=MetricsRegistry(),
+    )
+    assert proc2.gate is not None and proc2._pending_count == 2
+    proc2.flush()  # must not raise on the clock-less pending records
+    out = proc2.process("K", "C", timestamp=TS + 2, offset=2)
+    out = out + proc2.flush_event_time()
+    assert len(out) == 1  # the A->B->C run completes across the upgrade
+
+
+def test_idle_timeout_arms_when_records_precede_first_tick():
+    """Records observed before the first advance_wall (the driver
+    processes a poll's records before ticking) must still start the idle
+    clock (review finding)."""
+    gen = IdleTimeout(BoundedOutOfOrderness(50), timeout_ms=100)
+    gen.observe(1000)          # no wall tick has happened yet
+    gen.advance_wall(10)       # first tick: idle clock starts here
+    assert not gen.is_idle
+    gen.advance_wall(120)      # silent past the timeout
+    assert gen.is_idle and gen.current_ms() == 1000
+
+
+def test_min_merge_restore_rejects_mismatched_source_kind():
+    gen = MinMergeWatermark(
+        per_source={"s": IdleTimeout(BoundedOutOfOrderness(5), 100)}
+    )
+    gen.observe(1000, source="s")
+    state = gen.state()
+    fresh = MinMergeWatermark()  # default factory builds "bounded"
+    with pytest.raises(ValueError):
+        fresh.restore(state)
+    # pre-registered matching generators restore fine
+    ok = MinMergeWatermark(
+        per_source={"s": IdleTimeout(BoundedOutOfOrderness(5), 100)}
+    )
+    ok.restore(state)
+    assert ok.current_ms() == gen.current_ms()
+
+
+def test_host_pipeline_gate_survives_crash_via_changelog(tmp_path):
+    """Review finding: the host gate's buffered records + arrival marks
+    must restore from the event-time changelog store -- a crash between
+    buffering and release must not lose the buffered records (the
+    arrival marks would otherwise dedup their replay over an empty
+    buffer)."""
+    from kafkastreams_cep_tpu import (
+        ComplexStreamsBuilder, LogDriver, RecordLog, produce,
+    )
+
+    letters = [("A", TS + 0), ("C", TS + 2), ("B", TS + 1), ("X", TS + 40)]
+
+    def build(log):
+        b = ComplexStreamsBuilder(log=log, app_id="ethost")
+        out = (
+            b.stream("letters")
+            .query("q", abc_pattern(), reorder_capacity=32, lateness_ms=4,
+                   registry=MetricsRegistry())
+            .to("matches")
+        )
+        return b.build(), out
+
+    def run(crash_after_first_poll):
+        path = str(tmp_path / ("wal-%s" % crash_after_first_poll))
+        log = RecordLog(path)
+        for off, (v, ts) in enumerate(letters[:3]):
+            produce(log, "letters", "K", v, timestamp=ts)
+        log.flush()
+        topo, _out = build(log)
+        driver = LogDriver(topo, group="g")
+        driver.poll()   # A released; B, C buffered (watermark 4 ms back)
+        if crash_after_first_poll:
+            log.close()                      # simulated process death
+            log = RecordLog(path)
+            topo, _out = build(log)          # restore_stores replays the
+            driver = LogDriver(topo, group="g")  # event-time changelog
+        for off, (v, ts) in enumerate(letters[3:], start=3):
+            produce(log, "letters", "K", v, timestamp=ts)
+        while driver.poll(max_records=4):
+            pass
+        driver.drain_event_time()
+        got = [r.value for r in log.read("matches")]
+        log.close()
+        return got
+
+    golden = run(False)
+    assert golden, "the A->B->C run must complete"
+    assert run(True) == golden, (
+        "crash between buffering and release lost buffered records"
+    )
+
+
+def test_offer_batch_raise_is_chunk_atomic():
+    """Review finding: a CEPOverflowError mid-chunk must not consume the
+    chunk's earlier records (late admissions counted-but-lost, duplicate
+    releases on retry). Under 'raise' the capacity check runs before ANY
+    mutation."""
+    from kafkastreams_cep_tpu.faults import CEPOverflowError
+
+    reg = MetricsRegistry()
+    gate = EventTimeGate(
+        capacity=1, lateness_ms=10, late_policy="recompute-none",
+        on_overflow="raise", registry=reg, query_name="q",
+    )
+    gate.offer_batch([ev("a", 100, offset=0)])  # fills capacity 1
+    chunk = [ev("late", 50, offset=1), ev("b", 200, offset=2)]
+    with pytest.raises(CEPOverflowError):
+        gate.offer_batch(chunk)
+    fam = reg.snapshot().get("cep_late_admitted_total")
+    admitted = sum(v["value"] for v in fam["values"]) if fam else 0
+    assert admitted == 0, "late admission consumed by an aborted chunk"
+    assert gate.occupancy == 1  # nothing from the aborted chunk landed
+    # retry after draining admits the whole chunk exactly once
+    drained = gate.flush()
+    retry = gate.offer_batch(chunk)
+    retry += gate.flush()
+    assert len(drained) == 1 and len(retry) == 2
+
+
+def test_gate_drop_overflow_still_releases_passed_records():
+    """Review finding: a dropped-on-overflow arrival whose observation
+    advanced the watermark must release the records it passed in the
+    same call, not hold them for a later arrival."""
+    gate = EventTimeGate(
+        capacity=2, lateness_ms=5, on_overflow="drop",
+        registry=MetricsRegistry(),
+    )
+    assert gate.offer(ev("a", 100, offset=0)) == []
+    assert gate.offer(ev("b", 101, offset=1)) == []  # buffer now full
+    out = gate.offer(ev("c", 200, offset=2))  # dropped, but wm -> 195
+    assert [e.value for e, _ in out] == ["a", "b"]
+
+
+def test_gate_watermark_never_regresses_on_idle_resume():
+    """Review finding: an idle-jumped source resuming must not pull the
+    gate watermark back below records already released -- a regressed
+    mark would admit truly-late records and release them out of order."""
+    gen = IdleTimeout(BoundedOutOfOrderness(50), timeout_ms=100)
+    gate = EventTimeGate(
+        capacity=32, generator=gen, late_policy="drop",
+        registry=MetricsRegistry(),
+    )
+    gate.advance_wall(0)
+    order = []
+    for i, ts in enumerate((900, 950, 1000)):
+        order += gate.offer(ev(f"e{i}", ts, offset=i))
+    # source goes dark: the idle jump releases everything up to 1000
+    # (first tick anchors the grace period, the second arms idle)
+    order += gate.advance_wall(10_000)
+    order += gate.advance_wall(10_200)
+    assert [e.timestamp for e, _ in order] == [900, 950, 1000]
+    # source resumes: the inner bounded mark alone would REGRESS to 955
+    order += gate.offer(ev("r", 1005, offset=3))
+    assert gate.watermark_ms >= 1000
+    # a ts=970 arrival is now truly late (1000 already released): it
+    # must NOT be admitted behind the released records
+    order += gate.offer(ev("late", 970, offset=4))
+    order += gate.flush()
+    released_ts = [e.timestamp for e, _ in order]
+    assert released_ts == sorted(released_ts), released_ts
+    assert 970 not in released_ts  # dropped late, loudly -- never unsorted
+
+
+def test_min_merge_all_idle_rides_the_max_mark():
+    gen = MinMergeWatermark(default_factory=lambda: BoundedOutOfOrderness(0))
+    gen.observe(100, source="a")
+    gen.observe(900, source="b")
+    gen.mark_idle("a")
+    gen.mark_idle("b")
+    # min of idle marks would wedge b's buffered records at 100 forever
+    assert gen.current_ms() == 900
+
+
+def test_offer_raise_leaves_watermark_untouched():
+    """Review finding: a record rejected by CEPOverflowError must not
+    have advanced the watermark (offer() now mirrors offer_batch's
+    mutation-free escalation)."""
+    from kafkastreams_cep_tpu.faults import CEPOverflowError
+
+    gate = EventTimeGate(
+        capacity=1, lateness_ms=10_000, on_overflow="raise",
+        registry=MetricsRegistry(),
+    )
+    gate.offer(ev("a", 100, offset=0))  # buffers (watermark far behind)
+    wm_before = gate.watermark_ms
+    with pytest.raises(CEPOverflowError):
+        gate.offer(ev("b", 5000, offset=1))  # would jump the mark to 5000
+    assert gate.watermark_ms == wm_before
+    # an in-bound record behind the rejected one still admits (not late)
+    gate.flush()
+    assert gate.offer(ev("c", 101, offset=2)) != [] or gate.occupancy == 1
+
+
+def test_offer_batch_mixed_sources_observe_per_source():
+    """Review finding: a mixed-source chunk must observe each SOURCE's
+    own max -- attributing the chunk max to one source advances a
+    min-merge watermark past the slow sources and drops their in-bound
+    records as late."""
+    reg = MetricsRegistry()
+    gate = EventTimeGate(
+        capacity=32,
+        generator=MinMergeWatermark(
+            default_factory=lambda: BoundedOutOfOrderness(5)
+        ),
+        registry=reg, query_name="q",
+    )
+    gate.offer_batch([
+        ev("a", 100, topic="ex0", offset=0),
+        ev("b", 200, topic="ex1", offset=1),
+    ])
+    # merged watermark = min(ex0: 95, ex1: 195) -- an ex0 record at 101
+    # is IN BOUND and must admit (the single-source bug made wm 195)
+    assert gate.watermark_ms == 95
+    gate.offer_batch([ev("c", 101, topic="ex0", offset=2)])
+    fam = reg.snapshot().get("cep_late_dropped_total")
+    late = sum(v["value"] for v in fam["values"]) if fam else 0
+    assert late == 0
+
+
+def test_block_forced_release_stays_sorted_when_arrival_is_oldest():
+    """Review finding: under on_overflow='block', an arriving record
+    OLDER than the key's whole buffer must go late once the forced
+    release raises the floor -- pushing it would release behind the
+    forced-out record out of event-time order."""
+    reg = MetricsRegistry()
+    gate = EventTimeGate(
+        capacity=2, lateness_ms=100, on_overflow="block",
+        registry=reg, query_name="q",
+    )
+    out = []
+    out += gate.offer(ev("x", 150, offset=0))
+    out += gate.offer(ev("y", 160, offset=1))   # buffer full (wm=60)
+    out += gate.offer(ev("old", 100, offset=2))  # older than the buffer
+    out += gate.flush()
+    released_ts = [e.timestamp for e, _ in out]
+    assert released_ts == sorted(released_ts), released_ts
+    assert 100 not in released_ts
+    fam = reg.snapshot().get("cep_late_dropped_total")
+    assert fam and sum(v["value"] for v in fam["values"]) == 1
+
+
+def test_idle_timeout_not_armed_by_stale_restored_anchor():
+    """Review finding: after a checkpoint restore, the first wall tick
+    must not compare against the previous process's wall epoch -- a
+    just-active source would be declared idle after any long outage."""
+    gen = IdleTimeout(BoundedOutOfOrderness(50), timeout_ms=1000)
+    gen.advance_wall(1000)
+    gen.observe(100)
+    gen.advance_wall(1500)  # anchor at 1500, not idle
+    state = gen.state()
+
+    gen2 = IdleTimeout(BoundedOutOfOrderness(50), timeout_ms=1000)
+    gen2.restore(state)
+    gen2.observe(200)             # a record arrives right after restart
+    gen2.advance_wall(7_200_000)  # first tick, hours later
+    assert not gen2.is_idle       # just-active source: not idle
+    gen2.advance_wall(7_201_000)  # one full timeout of REAL silence
+    assert gen2.is_idle
+
+
+def test_host_query_accepts_on_overflow_alias():
+    """Review finding: the EngineConfig spelling `on_overflow` must work
+    as a host query kwarg (README: 'takes the same knobs')."""
+    from kafkastreams_cep_tpu import ComplexStreamsBuilder
+
+    b = ComplexStreamsBuilder()
+    b.stream("letters").query(
+        "q", abc_pattern(), reorder_capacity=4, lateness_ms=2,
+        on_overflow="raise", registry=MetricsRegistry(),
+    )
+    (_s, node, _o), = b._queries
+    assert node.processor.gate.on_overflow == "raise"
+
+
+def test_event_time_store_restore_rejects_config_mismatch(tmp_path):
+    """Review finding: a generator-config mismatch at changelog restore
+    must fail loudly, not be mis-counted as corruption and silently
+    restore an empty gate over committed offsets."""
+    from kafkastreams_cep_tpu import ComplexStreamsBuilder, LogDriver, RecordLog, produce
+
+    path = str(tmp_path / "wal")
+    log = RecordLog(path)
+    produce(log, "letters", "K", "A", timestamp=TS)
+    log.flush()
+
+    def build(lg, gen):
+        b = ComplexStreamsBuilder(log=lg, app_id="etmm")
+        b.stream("letters").query(
+            "q", abc_pattern(), reorder_capacity=8, lateness_ms=2,
+            watermark_gen=gen, registry=MetricsRegistry(),
+        )
+        return b.build()
+
+    topo = build(log, BoundedOutOfOrderness(2))
+    drv = LogDriver(topo, group="g")
+    drv.poll()
+    log.close()
+    log = RecordLog(path)
+    topo2 = build(log, ArrivalOrderWatermark())  # changed generator kind
+    with pytest.raises(Exception) as ei:
+        LogDriver(topo2, group="g")
+    assert "watermark generator" in str(ei.value) or "event-time" in str(
+        ei.value
+    )
+    log.close()
