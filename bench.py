@@ -1046,17 +1046,24 @@ def _compile_block(flagship_metrics: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
-def _regression_block(detail: Dict[str, Any], tunnel_degraded: bool):
+def _regression_block(
+    detail: Dict[str, Any], tunnel_degraded: bool, platform: str
+):
     """The artifact's `regression` block: deltas vs the --compare prior
     (None when --compare was not given). tunnel_degraded on EITHER side
-    excuses flags -- environment noise must not fail the check."""
+    -- or a platform change between the two rounds (cpu vs tpu) --
+    excuses flags: environment noise must not fail the check."""
     if ARGS.compare is None:
         return None
     _ensure_scripts_on_path()
     from perf_ledger import compare_artifacts, load_artifact
 
     prior = load_artifact(ARGS.compare)
-    cur = {"configs": detail, "tunnel_degraded": tunnel_degraded}
+    cur = {
+        "configs": detail,
+        "tunnel_degraded": tunnel_degraded,
+        "platform": platform,
+    }
     block = compare_artifacts(
         prior, cur, tolerance=ARGS.tolerance, prior_name=ARGS.compare
     )
@@ -1386,7 +1393,7 @@ def main() -> None:
         # Perf-regression verdict vs a --compare prior artifact (None
         # without --compare); scripts/perf_ledger.py computes the same
         # deltas over whole BENCH_r* trajectories.
-        "regression": _regression_block(detail, tunnel_degraded),
+        "regression": _regression_block(detail, tunnel_degraded, platform),
         # The merged cross-registry exposition (obs/merge.py), None
         # outside --smoke.
         "metrics_merged": metrics_merged,

@@ -1,9 +1,17 @@
-"""Chaos soak CLI: sweep seeded fault schedules against a live pipeline.
+"""Chaos CLI: seeded fault sweeps and the SLO-gated production soak.
 
+Two modes share this entry point:
+
+    # the chaos SWEEP (default; the original CLI): per-seed golden-vs-
+    # chaos digest equality over a crash/rebuild pipeline
     python -m kafkastreams_cep_tpu.faults --seeds 32 [--runtime tpu]
 
-For each seed it builds a fresh durable pipeline (letters query over a
-file-backed RecordLog in a temp dir), computes the fault-free golden sink
+    # the production SOAK (faults/soak.py): scenario fleet + chaos +
+    # self-scraped metrics time series + SLO verdict artifact
+    python -m kafkastreams_cep_tpu.faults soak --quick --out SOAK.json
+
+For each sweep seed it builds a fresh durable pipeline (letters query over
+a file-backed RecordLog in a temp dir), computes the fault-free golden sink
 stream, then replays the same stream under a seeded `FaultSchedule`,
 rebuilding from disk after every simulated crash -- the same harness as
 tests/test_faults.py, sized for soaking rather than CI. Any divergence
@@ -23,6 +31,17 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    argv = list(argv)
+    # Subcommand dispatch, backward compatible: bare flags keep running
+    # the original sweep ("sweep" is accepted as its explicit name).
+    if argv and argv[0] == "soak":
+        from .soak import main as soak_main
+
+        return soak_main(argv[1:])
+    if argv and argv[0] == "sweep":
+        argv = argv[1:]
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seeds", type=int, default=16, help="how many seeds")
     ap.add_argument("--seeds-from", type=int, default=0, help="first seed")

@@ -1,0 +1,922 @@
+"""SLO-gated production soak: scenario fleet + chaos + self-scraped verdict.
+
+Everything this harness composes already shipped as parts -- seeded chaos
+schedules (faults/injection.py), the HTTP introspection plane
+(obs/http.py), match-latency SLO histograms, watermark-lag gauges, the
+perf ledger -- but nothing ever ran them *together* for hours the way
+production would (ROADMAP item 7). This module is that run:
+
+- **Scenario fleet**: N queries x M workload generators. The base fleet
+  is the adversarial trio (models/adversarial.py): a key-skew hotspot
+  (optionally on the device runtime), a match-storm burst stream, and a
+  multi-source watermark stall through a gated (min-merge + idle-timeout)
+  event-time query -- plus a seeded query-churn plan that adds/removes
+  extra queries against the running log, rebuilding the topology under
+  traffic the way tenant churn would.
+- **Chaos**: a seeded FaultSchedule stays armed for the whole run;
+  injected crashes kill the pipeline mid-poll and the harness rebuilds
+  it from the durable RecordLog exactly as an operator restart would
+  (producer appends retry through torn-append crashes too).
+- **Self-scraping**: the pipeline serves its own introspection plane and
+  a scraper thread polls that live `/metrics` endpoint -- the same bytes
+  an external Prometheus would read -- into per-metric time-series rings
+  (obs/scrape.py) with min/max/last/slope summaries.
+- **Verdict**: a schema-validated `SOAK_r*.json` artifact gating on the
+  declared SLOs; exit status 0 only when every SLO holds. The artifact
+  embeds the scraped series summaries for every SLO-gated metric, so a
+  judge can distinguish a leak from a spike without re-running the soak.
+
+SLO set (scripts/check_bench_schema.py `SOAK_SLOS` pins the names):
+
+  evidence              the run actually produced/processed events,
+                        completed matches and scraped itself -- a soak
+                        that proves nothing must not pass
+  drops                 zero unexcused records lost (engine overflow
+                        drops, reorder overflow drops, late drops, DLQ
+                        quarantines)
+  p99_match_latency_ms  p99 of cep_match_latency_seconds across queries
+  watermark_lag_s       max scraped cep_watermark_lag_seconds
+  leak_drift            linear-fit drift of occupancy/region/reorder
+                        gauges and process RSS, bounded as a fraction of
+                        the observed level projected over the run
+  eps_regression        throughput vs a --compare prior artifact (SOAK
+                        or BENCH shape), reusing scripts/perf_ledger.py
+                        comparison logic verbatim -- tunnel-degraded and
+                        platform-change excusals included
+
+CLI (also `python -m kafkastreams_cep_tpu.faults soak ...`):
+
+    # CI-sized pass (<= 60 s wall), artifact to a temp path:
+    python -m kafkastreams_cep_tpu.faults soak --quick --out /tmp/SOAK.json
+
+    # the production shape: hours, device runtime in the fleet,
+    # regression-gated against the bench ledger:
+    python -m kafkastreams_cep_tpu.faults soak --duration 14400 \
+        --runtime mixed --compare BENCH_r06.json --p99-ms 1000
+
+    # seeded violation (forced reorder-overflow drops) -- must exit 1:
+    python -m kafkastreams_cep_tpu.faults soak --quick --violation drops
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# Same backend pinning as tests/conftest.py and faults/__main__.py: the
+# axon PJRT plugin hangs the process when the TPU tunnel is down.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SOAK_VERSION = 1
+
+#: Counter families whose nonzero totals are RECORD LOSS: the drops SLO
+#: sums these (minus per-scenario excusals) and demands zero.
+DROP_SERIES: Tuple[str, ...] = (
+    "cep_overflow_dropped_total",
+    "cep_reorder_overflow_dropped_total",
+    "cep_late_dropped_total",
+    "cep_driver_dead_letters_total",
+)
+
+#: Gauges whose monotone drift over a long run means a leak; the scraped
+#: summaries of every present series land in the verdict's `series`.
+LEAK_SERIES: Tuple[str, ...] = (
+    "cep_pend_occupancy",
+    "cep_region_fill",
+    "cep_reorder_occupancy",
+    "process_rss_bytes",
+)
+
+#: Every SLO-gated sample name whose scraped summary the verdict embeds.
+SLO_SERIES: Tuple[str, ...] = DROP_SERIES + LEAK_SERIES + (
+    "cep_watermark_lag_seconds",
+    "cep_match_latency_seconds_count",
+    "cep_match_latency_seconds_sum",
+)
+
+SLO_NAMES: Tuple[str, ...] = (
+    "evidence",
+    "drops",
+    "p99_match_latency_ms",
+    "watermark_lag_s",
+    "leak_drift",
+    "eps_regression",
+)
+
+
+@dataclass
+class SoakScenario:
+    """One fleet member: a generator feeding one query."""
+
+    name: str
+    generator: Any  # models.adversarial.AdversarialGenerator
+    pattern_fn: Callable[[], Any]
+    runtime: str = "host"
+    query_opts: Dict[str, Any] = field(default_factory=dict)
+    #: Drop families this scenario's query label excuses (none by
+    #: default: the fleet is built loss-free on purpose).
+    excused_drops: Tuple[str, ...] = ()
+    gated: bool = False
+
+    @property
+    def query(self) -> str:
+        return f"soak-{self.name}"
+
+    @property
+    def sink(self) -> str:
+        return f"{self.query}.matches"
+
+
+def _letters_pattern():
+    """Expression-form A->B->C (device-compilable AND host-runnable)."""
+    from ..pattern.builder import QueryBuilder
+    from ..pattern.expressions import value
+
+    return (
+        QueryBuilder()
+        .select("select-A").where(value() == "A")
+        .then().select("select-B").where(value() == "B")
+        .then().select("select-C").where(value() == "C")
+        .build()
+    )
+
+
+def _churn_pattern(name: str):
+    """Two-stage letter patterns for the churn queries (distinct shapes
+    so re-adding one after a removal recompiles a real topology delta)."""
+    from ..pattern.builder import QueryBuilder
+    from ..pattern.expressions import value
+
+    a, b = {"churn-a": ("A", "B"), "churn-b": ("B", "C")}.get(
+        name, ("A", "C")
+    )
+    return (
+        QueryBuilder()
+        .select(f"{name}-0").where(value() == a)
+        .then().select(f"{name}-1").where(value() == b)
+        .build()
+    )
+
+
+def build_fleet(
+    seed: int, runtime: str, quick: bool,
+    scenarios: Optional[List[str]] = None,
+) -> List[SoakScenario]:
+    """The default scenario fleet, seeded. `runtime` picks where the
+    hotspot runs: "host", "tpu", or "mixed" (hotspot on the device
+    runtime, the rest on host -- one soak exercises both drivers)."""
+    from ..models.adversarial import KeySkewHotspot, MatchStorm, WatermarkStall
+    from ..ops.engine import EngineConfig
+    from ..time.watermarks import (
+        BoundedOutOfOrderness,
+        IdleTimeout,
+        MinMergeWatermark,
+    )
+
+    hot_runtime = "tpu" if runtime in ("tpu", "mixed") else "host"
+    hot_opts: Dict[str, Any] = {}
+    if hot_runtime == "tpu":
+        # Quick sizing mirrors tests/test_faults.py DEVICE_OPTS exactly,
+        # so the CI soak rides the suite's warm compile cache instead of
+        # paying a fresh trace for a novel shape.
+        hot_opts = dict(
+            config=EngineConfig(lanes=8, nodes=256, matches=256,
+                                matches_per_step=4, nodes_per_step=8),
+            batch_size=5 if quick else 32,
+            initial_keys=2,
+        )
+    stall = WatermarkStall(
+        seed + 2, sources=3,
+        stall_after=300 if quick else 4000,
+    )
+    bound = stall.reorder_bound_ms
+    idle_timeout_ms = 1200 if quick else 5000
+    stall_topics = list(stall.topics)
+
+    def stall_watermark_gen():
+        # Fresh per topology build (a crash loses host state; reusing
+        # one generator object across rebuilds would resurrect it) --
+        # restore then comes from the event-time changelog, whose kinds
+        # must match this construction (time/watermarks.py restore).
+        return MinMergeWatermark(per_source={
+            (t, 0): IdleTimeout(BoundedOutOfOrderness(bound), idle_timeout_ms)
+            for t in stall_topics
+        })
+
+    fleet = [
+        SoakScenario(
+            name="hotspot",
+            generator=KeySkewHotspot(seed, keys=4 if quick else 8),
+            pattern_fn=_letters_pattern,
+            runtime=hot_runtime,
+            query_opts=hot_opts,
+        ),
+        SoakScenario(
+            name="match_storm",
+            generator=MatchStorm(
+                seed + 1,
+                quiet_len=60 if quick else 140,
+                storm_len=30 if quick else 60,
+            ),
+            pattern_fn=_letters_pattern,
+        ),
+        SoakScenario(
+            name="watermark_stall",
+            generator=stall,
+            pattern_fn=_letters_pattern,
+            gated=True,
+            query_opts=dict(
+                reorder_capacity=64 if quick else 512,
+                lateness_ms=bound,
+                # recompute-none: a spuriously-idled source (a CI pause
+                # longer than the idle timeout) must degrade to late
+                # ADMISSION, never silent loss -- the drops SLO stays
+                # meaningful under wall-clock noise.
+                late_policy="recompute-none",
+                reorder_overflow="block",
+                watermark_gen_factory=stall_watermark_gen,
+            ),
+        ),
+    ]
+    if scenarios:
+        unknown = set(scenarios) - {s.name for s in fleet}
+        if unknown:
+            raise ValueError(
+                f"unknown scenarios {sorted(unknown)} "
+                f"(known: {sorted(s.name for s in fleet)})"
+            )
+        fleet = [s for s in fleet if s.name in scenarios]
+    return fleet
+
+
+# --------------------------------------------------------------- the soak run
+class SoakRun:
+    """One soak execution: builds the fleet, pumps it under chaos until
+    the wall-clock deadline, then renders the verdict artifact."""
+
+    def __init__(self, args: argparse.Namespace) -> None:
+        self.args = args
+        self.crashes = 0
+        self.churn_epochs = 0
+        self.produced = 0
+        self.processed = 0
+        self.driver = None
+        self.log = None
+        self._live_churn: Tuple[str, ...] = ()
+
+    # ----------------------------------------------------------- topology
+    def _build_topology(self, registry):
+        from ..streams.builder import ComplexStreamsBuilder
+
+        builder = ComplexStreamsBuilder(log=self.log, app_id="soak")
+        for sc in self.fleet:
+            opts = dict(sc.query_opts)
+            factory = opts.pop("watermark_gen_factory", None)
+            if factory is not None:
+                opts["watermark_gen"] = factory()
+            builder.stream(sc.generator.topics).query(
+                sc.query, sc.pattern_fn(), runtime=sc.runtime,
+                registry=registry, **opts,
+            ).to(sc.sink)
+        # Churn queries ride the match_storm topic (it always carries
+        # traffic); their live set is the churn plan's current epoch.
+        # Fallback on a subset fleet: the first scenario's first REAL
+        # topic (generator.topics -- multi-source generators never
+        # produce into their bare `topic` prefix).
+        churn_topic = next(
+            (s.generator.topic for s in self.fleet
+             if s.name == "match_storm"),
+            self.fleet[0].generator.topics[0],
+        )
+        for qname in self._live_churn:
+            builder.stream(churn_topic).query(
+                qname, _churn_pattern(qname), runtime="host",
+                registry=registry,
+            ).to(f"{qname}.matches")
+        return builder.build()
+
+    def _rebuild(self, registry) -> None:
+        from ..streams.driver import LogDriver
+
+        self.driver = LogDriver(
+            self._build_topology(registry), group="soak", registry=registry,
+        )
+
+    def _crash_recover(self, registry) -> None:
+        from ..streams.log import RecordLog
+
+        self.crashes += 1
+        try:
+            self.log.close()
+        except Exception:
+            pass
+        self.log = RecordLog(self._log_path)
+        self._rebuild(registry)
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> Dict[str, Any]:
+        import jax
+
+        from ..models.adversarial import QueryChurnPlan
+        from ..obs import IntrospectionServer, MetricsRegistry, SpanTracer
+        from ..obs.scrape import MetricsScraper
+        from ..streams.driver import produce
+        from ..streams.log import RecordLog
+        from . import FaultInjector, FaultPoint, FaultSchedule, armed
+        from .injection import InjectedCrash
+
+        args = self.args
+        registry = MetricsRegistry()
+        self.fleet = build_fleet(
+            args.seed, args.runtime, args.quick,
+            scenarios=args.scenarios,
+        )
+        if args.violation == "drops" and not any(
+            sc.gated for sc in self.fleet
+        ):
+            # The violation forces reorder-buffer loss, which needs a
+            # gated scenario in the fleet -- silently passing a run the
+            # operator asked to FAIL would invert the contract.
+            raise ValueError(
+                "--violation drops needs a gated scenario in the fleet "
+                "(include watermark_stall in --scenarios)"
+            )
+        workdir = args.dir or tempfile.mkdtemp(prefix="cep-soak-")
+        self._log_path = os.path.join(workdir, "wal")
+        self.log = RecordLog(self._log_path)
+
+        churn = QueryChurnPlan(args.seed, period_s=args.churn_period)
+        self._live_churn = churn.live(0)
+
+        sites = [
+            "driver.pre_commit", "driver.post_commit", "log.torn_append",
+            "time.reorder_overflow",
+        ]
+        if any(sc.runtime == "tpu" for sc in self.fleet):
+            sites.append("engine.mid_drain")
+        points: List[FaultPoint] = []
+        if args.chaos_points > 0:
+            points.extend(
+                FaultSchedule.seeded(
+                    args.seed, sites=sites, n_points=args.chaos_points,
+                    max_hit=max(6, args.chaos_points * 2),
+                ).points
+            )
+        if args.violation == "drops":
+            # The seeded violation: force reorder-buffer pressure on the
+            # gated query while its overflow policy is "drop" -- records
+            # are lost LOUDLY and the drops SLO must flip the verdict.
+            for sc in self.fleet:
+                if sc.gated:
+                    sc.query_opts["reorder_overflow"] = "drop"
+            points.extend(
+                FaultPoint("time.reorder_overflow", h)
+                for h in range(1, 9)
+            )
+        schedule = FaultSchedule(points)
+
+        self._rebuild(registry)
+        tracer = SpanTracer(registry)
+
+        def _health() -> Dict[str, Any]:
+            body: Dict[str, Any] = {
+                "soak": {
+                    "crashes": self.crashes,
+                    "churn_epochs": self.churn_epochs,
+                    "events_produced": self.produced,
+                    "events_processed": self.processed,
+                    "live_churn_queries": list(self._live_churn),
+                },
+            }
+            drv = self.driver
+            if drv is not None:
+                try:
+                    body.update(drv.health())
+                except Exception:
+                    pass  # mid-rebuild: the soak block alone answers
+            return body
+
+        # The soak owns ONE IntrospectionServer over the shared registry
+        # (not driver.serve_http: a chaos rebuild would re-bind the port
+        # mid-run and strand the scraper).
+        server = IntrospectionServer(
+            registry=registry, tracer=tracer, health_fn=_health,
+            port=args.http_port,
+        ).start()
+        scraper = MetricsScraper(
+            url=server.url, every_s=args.scrape_every,
+        ).start()
+        print(f"[soak] introspection plane: {server.url}", file=sys.stderr)
+
+        t0 = time.time()
+        deadline = t0 + args.duration
+        epoch = 0
+        try:
+            with armed(FaultInjector(schedule, registry=registry)):
+                while time.time() < deadline:
+                    new_epoch = churn.epoch_at(time.time() - t0)
+                    if new_epoch != epoch:
+                        epoch = new_epoch
+                        self._live_churn = churn.live(epoch)
+                        self.churn_epochs += 1
+                        # Orderly churn: commit, tear down, rebuild with
+                        # the epoch's query set (stores restore from the
+                        # changelog, so a re-added query resumes). The
+                        # close's final commit appends offsets, so an
+                        # unfired torn-append point can bite HERE too --
+                        # recover like any other crash instead of
+                        # aborting an hours-long run verdict-less.
+                        try:
+                            self.driver.close()
+                            self._rebuild(registry)
+                        except InjectedCrash:
+                            self._crash_recover(registry)
+                    for sc in self.fleet:
+                        for ev in sc.generator.chunk(args.chunk):
+                            while True:
+                                try:
+                                    produce(
+                                        self.log, ev.topic, ev.key,
+                                        ev.value, timestamp=ev.timestamp,
+                                    )
+                                    break
+                                except InjectedCrash:
+                                    # Torn producer append: the frame
+                                    # never became durable (reload
+                                    # truncates it), so the retry cannot
+                                    # duplicate.
+                                    self._crash_recover(registry)
+                            self.produced += 1
+                    try:
+                        self.processed += self.driver.poll()
+                    except InjectedCrash:
+                        self._crash_recover(registry)
+                # End of run: drain the produced backlog (a crash just
+                # before the deadline leaves records polled by nobody),
+                # release gated stragglers and flush.
+                for _ in range(4):
+                    try:
+                        n = self.driver.poll()
+                        self.processed += n
+                        if n == 0:
+                            break
+                    except InjectedCrash:
+                        self._crash_recover(registry)
+                try:
+                    self.driver.drain_event_time()
+                except InjectedCrash:
+                    # An unfired torn-append point biting the final
+                    # flush: recover once and finish the drain.
+                    self._crash_recover(registry)
+                    self.driver.drain_event_time()
+        finally:
+            wall = time.time() - t0
+            scraper.stop(final_scrape=True)
+            server.stop()
+            try:
+                self.driver.close()
+            except Exception:
+                pass
+            try:
+                self.log.flush()
+            except Exception:
+                pass
+
+        return self._verdict(registry, scraper, wall, jax)
+
+    # ------------------------------------------------------------- verdict
+    def _drop_totals(self, registry) -> Tuple[Dict[str, float], float, float]:
+        """(per-family totals, unexcused sum, excused sum). Excusal is
+        per (family, query label): a scenario may declare an expected,
+        policy-intended loss family for ITS query; everything else
+        counts."""
+        excuse: Dict[str, set] = {}
+        for sc in self.fleet:
+            for fam in sc.excused_drops:
+                excuse.setdefault(fam, set()).add(sc.query)
+        totals: Dict[str, float] = {}
+        unexcused = 0.0
+        excused = 0.0
+        for fam_name in DROP_SERIES:
+            metric = registry.get(fam_name)
+            fam_total = 0.0
+            if metric is not None:
+                label_names = metric.label_names
+                for lvals, child in metric._sorted_children():
+                    fam_total += child.value
+                    labels = dict(zip(label_names, lvals))
+                    if labels.get("query") in excuse.get(fam_name, ()):
+                        excused += child.value
+                    else:
+                        unexcused += child.value
+            totals[fam_name] = fam_total
+        return totals, unexcused, excused
+
+    def _verdict(
+        self, registry, scraper, wall: float, jax_mod
+    ) -> Dict[str, Any]:
+        args = self.args
+        platform = jax_mod.devices()[0].platform
+
+        matches_by_query: Dict[str, int] = {}
+        for sc in self.fleet:
+            matches_by_query[sc.query] = len(self.log.read(sc.sink))
+        total_matches = sum(matches_by_query.values())
+
+        slos: Dict[str, Dict[str, Any]] = {}
+
+        def slo(name, ok, value=None, bound=None, excused=False,
+                detail=None):
+            slos[name] = {
+                "ok": bool(ok),
+                "value": value,
+                "bound": bound,
+                "excused": bool(excused),
+                "detail": detail,
+            }
+
+        # evidence: the run must have proven SOMETHING -- traffic moved,
+        # matches completed, the plane answered its own scraper.
+        slo(
+            "evidence",
+            self.produced > 0 and self.processed > 0
+            and total_matches > 0 and scraper.scrapes > 0,
+            value=float(total_matches),
+            bound=1.0,
+            detail={
+                "events_produced": self.produced,
+                "events_processed": self.processed,
+                "matches": total_matches,
+                "scrapes": scraper.scrapes,
+                "scrape_errors": scraper.errors,
+            },
+        )
+
+        totals, unexcused, excused_drops = self._drop_totals(registry)
+        slo(
+            "drops",
+            unexcused <= args.max_drops,
+            value=unexcused,
+            bound=args.max_drops,
+            excused=excused_drops > 0,
+            detail=dict(totals, excused=excused_drops),
+        )
+
+        # p99 match latency: worst query's reservoir percentile.
+        p99_ms: Optional[float] = None
+        per_query_p99: Dict[str, Any] = {}
+        fam = registry.get("cep_match_latency_seconds")
+        if fam is not None:
+            for lvals, child in fam._sorted_children():
+                p = child.percentile(99)
+                labels = dict(zip(fam.label_names, lvals))
+                per_query_p99[labels.get("query", "?")] = (
+                    None if p is None else p * 1e3
+                )
+                if p is not None:
+                    p99_ms = max(p99_ms or 0.0, p * 1e3)
+        slo(
+            "p99_match_latency_ms",
+            p99_ms is not None and p99_ms <= args.p99_ms,
+            value=p99_ms,
+            bound=args.p99_ms,
+            detail={"per_query_p99_ms": per_query_p99},
+        )
+
+        lag_ring = scraper.get("cep_watermark_lag_seconds")
+        lag_max = lag_ring.max if lag_ring is not None else None
+        has_gated = any(sc.gated for sc in self.fleet)
+        slo(
+            "watermark_lag_s",
+            (not has_gated) or (lag_max is not None and lag_max <= args.lag_s),
+            value=lag_max,
+            bound=args.lag_s,
+            detail=None,
+        )
+
+        # leak_drift: per-series linear fit, projected over the run and
+        # normalized by the observed level. A leak must BOTH trend up
+        # (the fit) AND end up (net growth: last - min): a pressure
+        # spike that fully recovered fits a steep slope over a short
+        # window but nets ~zero -- occupancy that came back down is
+        # back-pressure working, not a leak.
+        leak_detail: Dict[str, Any] = {}
+        worst_frac = 0.0
+        for name in LEAK_SERIES:
+            ring = scraper.get(name)
+            if ring is None or ring.n < 3:
+                continue
+            s = ring.summary()
+            level = max(abs(s["max"]), 1.0)
+            frac_slope = s["slope_per_s"] * wall / level
+            frac_net = (s["last"] - s["min"]) / level
+            frac = min(frac_slope, frac_net)
+            leak_detail[name] = {
+                "slope_per_s": s["slope_per_s"],
+                "projected_frac_of_level": frac_slope,
+                "net_growth_frac_of_level": frac_net,
+                "ok": frac <= args.leak_frac,
+            }
+            worst_frac = max(worst_frac, frac)
+        slo(
+            "leak_drift",
+            all(d["ok"] for d in leak_detail.values()),
+            value=worst_frac,
+            bound=args.leak_frac,
+            detail=leak_detail,
+        )
+
+        # eps_regression: scripts/perf_ledger.py comparison logic reused
+        # verbatim over {soak scenario -> eps} pseudo-configs.
+        eps = self.processed / wall if wall > 0 else 0.0
+        scenario_eps = {
+            f"soak_{sc.name}": {"eps": sc.generator.produced / wall}
+            for sc in self.fleet
+            if wall > 0
+        }
+        reg_block = None
+        reg_ok = True
+        reg_excused = False
+        if args.compare:
+            reg_block = _eps_regression_block(
+                args.compare, scenario_eps, platform, args.tolerance,
+            )
+            reg_ok = not reg_block["regressed"] or reg_block["excused"]
+            reg_excused = reg_block["excused"]
+        slo(
+            "eps_regression",
+            reg_ok,
+            value=None,
+            bound=args.tolerance,
+            excused=reg_excused,
+            detail=reg_block,
+        )
+
+        passed = all(entry["ok"] for entry in slos.values())
+
+        from ..obs.registry import default_registry, fault_series_totals
+
+        out: Dict[str, Any] = {
+            "soak": {
+                "version": SOAK_VERSION,
+                "seed": args.seed,
+                "quick": bool(args.quick),
+                "platform": platform,
+                "runtime": args.runtime,
+                "violation": args.violation,
+                "duration_s": args.duration,
+                "wall_s": wall,
+                "events_produced": self.produced,
+                "events_processed": self.processed,
+                "matches": total_matches,
+                "eps": eps,
+                "crashes": self.crashes,
+                "chaos_points": args.chaos_points,
+                "churn_epochs": self.churn_epochs,
+                "scrapes": scraper.scrapes,
+                "scrape_errors": scraper.errors,
+            },
+            "scenarios": {
+                sc.name: {
+                    "generator": type(sc.generator).__name__,
+                    "runtime": sc.runtime,
+                    "topics": list(sc.generator.topics),
+                    "events": sc.generator.produced,
+                    "matches": matches_by_query.get(sc.query, 0),
+                    "eps": (
+                        sc.generator.produced / wall if wall > 0 else 0.0
+                    ),
+                    "gated": sc.gated,
+                }
+                for sc in self.fleet
+            },
+            "slos": slos,
+            "series": scraper.summaries(SLO_SERIES),
+            "metrics": registry.snapshot(),
+            "faults": fault_series_totals(registry, default_registry()),
+            "passed": passed,
+        }
+        return out
+
+
+def _eps_regression_block(
+    prior_path: str,
+    scenario_eps: Dict[str, Dict[str, float]],
+    platform: str,
+    tolerance: float,
+) -> Dict[str, Any]:
+    """compare_artifacts over the soak's pseudo-configs. A prior SOAK
+    artifact is folded into bench shape first (its scenarios become
+    configs); BENCH priors pass straight through perf_ledger ingestion
+    -- shared config names compare, the rest is reported as missing."""
+    _ensure_scripts_on_path()
+    from perf_ledger import compare_artifacts, load_artifact
+
+    with open(prior_path) as f:
+        try:
+            prior_doc = json.load(f)
+        except json.JSONDecodeError:
+            prior_doc = None
+    if isinstance(prior_doc, dict) and "soak" in prior_doc:
+        prior: Dict[str, Any] = {
+            "configs": {
+                f"soak_{name}": {"eps": sc.get("eps")}
+                for name, sc in (prior_doc.get("scenarios") or {}).items()
+                if isinstance(sc, dict)
+            },
+            "tunnel_degraded": False,
+            "platform": (prior_doc.get("soak") or {}).get("platform"),
+        }
+    else:
+        prior = load_artifact(prior_path)
+    cur = {
+        "configs": scenario_eps,
+        "tunnel_degraded": False,
+        "platform": platform,
+    }
+    return compare_artifacts(
+        prior, cur, tolerance=tolerance, prior_name=prior_path,
+    )
+
+
+def _ensure_scripts_on_path() -> None:
+    """Make scripts/ importable from a repo checkout (check_bench_schema,
+    perf_ledger); a site-packages install simply skips validation."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    scripts = os.path.join(here, "scripts")
+    if os.path.isdir(scripts) and scripts not in sys.path:
+        sys.path.insert(0, scripts)
+
+
+def next_artifact_path(directory: str = ".") -> str:
+    """The next SOAK_rNN.json slot in `directory`."""
+    taken = []
+    for name in os.listdir(directory or "."):
+        m = re.match(r"SOAK_r(\d+)\.json$", name)
+        if m:
+            taken.append(int(m.group(1)))
+    return os.path.join(
+        directory, f"SOAK_r{(max(taken) + 1 if taken else 1):02d}.json"
+    )
+
+
+# --------------------------------------------------------------------- CLI
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="kafkastreams_cep_tpu.faults soak",
+        description=__doc__.split("\n\n")[0],
+    )
+    ap.add_argument("--duration", type=float, default=3600.0,
+                    help="wall-clock seconds to soak (default 1 hour)")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI sizing: <=60 s wall, tiny fleet configs "
+                    "(caps --duration at 20 s unless given explicitly "
+                    "smaller)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--runtime", default="mixed",
+                    choices=["host", "tpu", "mixed"],
+                    help="where the hotspot scenario runs (mixed = device "
+                    "runtime for it, host for the rest)")
+    ap.add_argument("--scenarios", default=None,
+                    help="comma-separated fleet subset "
+                    "(hotspot,match_storm,watermark_stall)")
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="events per scenario per pump iteration")
+    ap.add_argument("--chaos-points", type=int, default=None,
+                    help="seeded fault points armed for the run "
+                    "(default: 3 quick, else ~1/minute; 0 disarms)")
+    ap.add_argument("--churn-period", type=float, default=None,
+                    help="seconds per query-churn epoch")
+    ap.add_argument("--scrape-every", type=float, default=None,
+                    help="self-scrape cadence in seconds")
+    ap.add_argument("--http-port", type=int, default=0,
+                    help="introspection plane port (0 = ephemeral)")
+    ap.add_argument("--dir", default=None,
+                    help="workdir for the durable RecordLog "
+                    "(default: fresh temp dir)")
+    ap.add_argument("--out", default=None,
+                    help="verdict artifact path (default: next "
+                    "SOAK_rNN.json in the current directory)")
+    ap.add_argument("--compare", default=None, metavar="PRIOR_JSON",
+                    help="prior SOAK/BENCH artifact for the "
+                    "eps_regression SLO (perf_ledger comparison logic)")
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="fractional eps drop the regression SLO flags")
+    ap.add_argument("--p99-ms", type=float, default=None,
+                    help="p99 match-latency bound in ms (default: 30000 "
+                    "quick -- CI boxes pay compiles in-run -- else 10000: "
+                    "gated queries legitimately hold matches for the "
+                    "reorder wait + idle timeout)")
+    ap.add_argument("--lag-s", type=float, default=None,
+                    help="max watermark-lag bound in seconds "
+                    "(default: 60 quick, else 30)")
+    ap.add_argument("--leak-frac", type=float, default=None,
+                    help="leak bound: fitted drift projected over the "
+                    "run AND net growth, each as a fraction of the "
+                    "observed level (default: 0.5 quick -- compiles "
+                    "grow RSS in-run -- else 0.1)")
+    ap.add_argument("--max-drops", type=float, default=0.0,
+                    help="unexcused dropped-record budget (default 0)")
+    ap.add_argument("--violation", default="none",
+                    choices=["none", "drops"],
+                    help="seeded SLO violation for verdict testing: "
+                    "'drops' forces reorder-overflow record loss")
+    return ap
+
+
+def _resolve_defaults(args: argparse.Namespace) -> argparse.Namespace:
+    if args.quick:
+        args.duration = min(args.duration, 20.0)
+    if args.chunk is None:
+        args.chunk = 24 if args.quick else 128
+    if args.chaos_points is None:
+        args.chaos_points = 3 if args.quick else max(4, int(args.duration / 60))
+    if args.churn_period is None:
+        args.churn_period = (
+            max(1.5, args.duration / 4) if args.quick else 60.0
+        )
+    if args.scrape_every is None:
+        args.scrape_every = (
+            max(0.2, args.duration / 30) if args.quick else 5.0
+        )
+    if args.p99_ms is None:
+        args.p99_ms = 30_000.0 if args.quick else 10_000.0
+    if args.lag_s is None:
+        args.lag_s = 60.0 if args.quick else 30.0
+    if args.leak_frac is None:
+        args.leak_frac = 0.5 if args.quick else 0.1
+    if args.scenarios is not None:
+        args.scenarios = [
+            s.strip() for s in args.scenarios.split(",") if s.strip()
+        ]
+    return args
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _resolve_defaults(build_parser().parse_args(argv))
+    if args.compare and not os.path.isfile(args.compare):
+        # Fail BEFORE the run: discovering a typo'd prior path at
+        # verdict time would throw away hours of soak evidence.
+        print(f"[soak] --compare: no such file {args.compare!r}",
+              file=sys.stderr)
+        return 2
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    try:
+        out = SoakRun(args).run()
+    except ValueError as exc:
+        print(f"[soak] {exc}", file=sys.stderr)
+        return 2
+
+    # Schema validation (check_bench_schema.validate_soak) before the
+    # artifact lands: a malformed verdict must fail the run, not the
+    # next reader.
+    schema_errors: List[str] = []
+    _ensure_scripts_on_path()
+    try:
+        from check_bench_schema import validate_soak
+
+        schema_errors = validate_soak(out)
+        out["schema_ok"] = not schema_errors
+    except ImportError:
+        pass  # installed outside a repo checkout: nothing to check with
+    for e in schema_errors:
+        print(f"[soak] SCHEMA: {e}", file=sys.stderr)
+
+    path = args.out or next_artifact_path(".")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "metrics"}))
+
+    s = out["soak"]
+    verdict = "PASS" if out["passed"] else "FAIL"
+    print(
+        f"[soak] {verdict}: {s['events_processed']} events, "
+        f"{s['matches']} matches, {s['eps']:.0f} ev/s, "
+        f"{s['crashes']} crashes, {s['churn_epochs']} churn epochs, "
+        f"{s['scrapes']} scrapes over {s['wall_s']:.1f}s -> {path}",
+        file=sys.stderr,
+    )
+    for name, entry in out["slos"].items():
+        flag = "ok" if entry["ok"] else "VIOLATED"
+        print(
+            f"[soak]   {name}: {flag} (value={entry['value']} "
+            f"bound={entry['bound']})", file=sys.stderr,
+        )
+    if schema_errors:
+        return 1
+    return 0 if out["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,7 @@ from .registry import (
     parse_prom_text,
     registry_from_snapshot,
 )
+from .scrape import MetricsScraper, TimeSeries
 from .trace import SpanTracer
 from .trace_export import chrome_trace, write_chrome_trace
 
@@ -34,7 +35,9 @@ __all__ = [
     "Histogram",
     "IntrospectionServer",
     "MetricsRegistry",
+    "MetricsScraper",
     "SpanTracer",
+    "TimeSeries",
     "default_registry",
     "fault_series_totals",
     "merge_registries",

@@ -548,6 +548,35 @@ class Topology:
                 topic, key_bytes, value_bytes, timestamp=record.timestamp
             )
 
+    def event_time_health(self) -> Dict[str, Any]:
+        """Event-time plane liveness for /healthz (ISSUE 12 satellite):
+        per-gated-query watermark lag and reorder-buffer occupancy, plus
+        the fleet aggregates an operator gates on without parsing prom
+        text. Queries without a gate are simply absent; a topology with
+        none reports ``{"gated_queries": 0, ...}`` zeros."""
+        per_query: Dict[str, Any] = {}
+        occupancy = 0
+        lag_max: Optional[float] = None
+        for _stream, node, _out in self.queries:
+            gate = getattr(node.processor, "gate", None)
+            if gate is None:
+                continue
+            lag_ms = gate.watermark_lag_ms
+            lag_s = None if lag_ms is None else lag_ms / 1e3
+            per_query[node.name] = {
+                "watermark_lag_s": lag_s,
+                "reorder_occupancy": gate.occupancy,
+            }
+            occupancy += gate.occupancy
+            if lag_s is not None:
+                lag_max = lag_s if lag_max is None else max(lag_max, lag_s)
+        return {
+            "gated_queries": len(per_query),
+            "reorder_occupancy": occupancy,
+            "watermark_lag_s_max": lag_max,
+            "queries": per_query,
+        }
+
     def take_poisoned(self) -> List[tuple]:
         """Drain every processor's quarantined records ([(query, key,
         event, exception)]) -- the driver dead-letters them after each

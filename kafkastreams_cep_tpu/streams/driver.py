@@ -458,6 +458,18 @@ class LogDriver:
                 child.value
                 for _lv, child in self._m_dead_letters._sorted_children()
             ),
+            # DLQ-quarantine breakdown (ISSUE 12 satellite): which topic
+            # poisoned and why, without parsing prom text.
+            "dead_letters_by_reason": {
+                f"{topic}/{reason}": child.value
+                for (topic, reason), child
+                in self._m_dead_letters._sorted_children()
+            },
+            # The PR 9 event-time plane (ISSUE 12 satellite): watermark
+            # lag + reorder-buffer occupancy per gated query, so the
+            # soak (and operators) gate on event-time health from the
+            # same JSON the liveness probes already read.
+            "event_time": self.topology.event_time_health(),
             "faults_armed": _flt.ACTIVE is not None,
             "report_every_s": self.report_every_s,
         }

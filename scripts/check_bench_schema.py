@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Validate a bench.py JSON artifact against the documented schema.
+"""Validate a bench.py or soak JSON artifact against the documented schema.
 
 BENCH_r*.json artifacts must stay self-describing (PERF.md "v10 metrics
 dictionary" documents every key): this checker fails on BOTH missing
@@ -10,9 +10,19 @@ the same PR. It also proves the two metric expositions agree: the
 registry, rendered as Prometheus 0.0.4 text, parsed back, and compared
 value-for-value.
 
+SOAK_r*.json verdict artifacts (ISSUE 12, faults/soak.py) are validated
+by the same both-ways rule via `validate_soak`: the SLO set is pinned to
+`SOAK_SLOS` exactly, every SLO entry carries the documented verdict
+shape, every scraped series summary carries min/max/last/slope, and the
+`metrics`/`faults` sections reuse the bench contract (snapshot
+round-trip + FAULT_SERIES key pinning). `main` dispatches on the
+artifact shape, so one CLI checks both.
+
 Usage:
     python scripts/check_bench_schema.py BENCH.json   # or - for stdin
-bench.py --smoke runs validate() on its own output before printing.
+    python scripts/check_bench_schema.py SOAK_r01.json
+bench.py --smoke and the soak harness run validate()/validate_soak() on
+their own output before printing.
 """
 from __future__ import annotations
 
@@ -112,6 +122,13 @@ REGRESSION_KEYS: Dict[str, tuple] = {
     "excused": (bool,),
     "tunnel_degraded_prev": (bool,),
     "tunnel_degraded_cur": (bool,),
+    # Platform-change excusal (ISSUE 12): a round recorded on a
+    # different backend (cpu vs tpu) is an environment delta, not a code
+    # regression -- both sides' platforms ride the block so the excusal
+    # is auditable. None when the prior predates self-described
+    # platforms (truncated wrappers).
+    "platform_prev": (str, type(None)),
+    "platform_cur": (str, type(None)),
 }
 REGRESSION_METRIC_KEYS: Dict[str, tuple] = {
     "prev": NUMBER,
@@ -160,6 +177,165 @@ COMPONENT_KEYS: Dict[str, tuple] = {
 }
 
 METRIC_KINDS = ("counter", "gauge", "histogram")
+
+# ---------------------------------------------------------------- SOAK schema
+#: Top-level contract of a SOAK_r*.json verdict (faults/soak.py). Same
+#: both-ways rule as the bench artifact.
+SOAK_TOP_LEVEL: Dict[str, Tuple[bool, tuple]] = {
+    "soak": (True, (dict,)),
+    "scenarios": (True, (dict,)),
+    "slos": (True, (dict,)),
+    "series": (True, (dict,)),
+    "metrics": (True, (dict,)),
+    "faults": (True, (dict,)),
+    "passed": (True, (bool,)),
+    "schema_ok": (False, (bool,)),
+}
+
+#: The `soak` run-description block.
+SOAK_RUN_KEYS: Dict[str, tuple] = {
+    "version": NUMBER,
+    "seed": NUMBER,
+    "quick": (bool,),
+    "platform": (str,),
+    "runtime": (str,),
+    "violation": (str,),
+    "duration_s": NUMBER,
+    "wall_s": NUMBER,
+    "events_produced": NUMBER,
+    "events_processed": NUMBER,
+    "matches": NUMBER,
+    "eps": NUMBER,
+    "crashes": NUMBER,
+    "chaos_points": NUMBER,
+    "churn_epochs": NUMBER,
+    "scrapes": NUMBER,
+    "scrape_errors": NUMBER,
+}
+
+#: The SLO name set -- pinned EXACTLY (a soak that silently stops gating
+#: an SLO must fail its own schema).
+SOAK_SLOS: Tuple[str, ...] = (
+    "evidence",
+    "drops",
+    "p99_match_latency_ms",
+    "watermark_lag_s",
+    "leak_drift",
+    "eps_regression",
+)
+
+#: One SLO verdict entry: the machine-gateable shape.
+SOAK_SLO_KEYS: Dict[str, tuple] = {
+    "ok": (bool,),
+    "value": OPT_NUMBER,
+    "bound": OPT_NUMBER,
+    "excused": (bool,),
+    "detail": (dict, type(None)),
+}
+
+#: One scraped time-series summary (obs/scrape.py TimeSeries.summary):
+#: min/max/last/slope let a judge tell a leak from a spike offline.
+SOAK_SERIES_KEYS: Dict[str, tuple] = {
+    "n": NUMBER,
+    "min": NUMBER,
+    "max": NUMBER,
+    "last": NUMBER,
+    "slope_per_s": NUMBER,
+}
+
+#: One scenario detail entry.
+SOAK_SCENARIO_KEYS: Dict[str, tuple] = {
+    "generator": (str,),
+    "runtime": (str,),
+    "topics": (list,),
+    "events": NUMBER,
+    "matches": NUMBER,
+    "eps": NUMBER,
+    "gated": (bool,),
+}
+
+
+def looks_like_soak(doc: Any) -> bool:
+    """Shape dispatch for main(): soak verdicts carry `soak` + `slos`."""
+    return isinstance(doc, dict) and "soak" in doc and "slos" in doc
+
+
+def validate_soak(out: Any) -> List[str]:
+    """Schema violations for a SOAK_r*.json verdict (empty = valid)."""
+    errors: List[str] = []
+    if not isinstance(out, dict):
+        return [f"soak artifact must be a JSON object, got {type(out).__name__}"]
+    for key, (required, types) in SOAK_TOP_LEVEL.items():
+        if key not in out:
+            if required:
+                errors.append(f"missing documented key {key!r}")
+            continue
+        if not isinstance(out[key], types):
+            errors.append(
+                f"{key}: expected {tuple(t.__name__ for t in types)}, "
+                f"got {type(out[key]).__name__}"
+            )
+    for key in out:
+        if key not in SOAK_TOP_LEVEL:
+            errors.append(
+                f"undocumented key {key!r} (document it in PERF.md and "
+                "scripts/check_bench_schema.py SOAK_TOP_LEVEL)"
+            )
+    if isinstance(out.get("soak"), dict):
+        _check_flat_block(out["soak"], SOAK_RUN_KEYS, "soak", errors)
+    slos = out.get("slos")
+    if isinstance(slos, dict):
+        for name in SOAK_SLOS:
+            if name not in slos:
+                errors.append(f"slos: missing SLO {name!r}")
+        for name, entry in slos.items():
+            if name not in SOAK_SLOS:
+                errors.append(f"slos: undocumented SLO {name!r}")
+            if not isinstance(entry, dict):
+                errors.append(f"slos.{name}: expected object")
+                continue
+            _check_flat_block(entry, SOAK_SLO_KEYS, f"slos.{name}", errors)
+            # The regression SLO's detail is a perf_ledger
+            # compare_artifacts block: hold it to that contract.
+            if name == "eps_regression" and isinstance(
+                entry.get("detail"), dict
+            ):
+                _check_flat_block(
+                    entry["detail"], REGRESSION_KEYS,
+                    "slos.eps_regression.detail", errors,
+                )
+    if isinstance(out.get("series"), dict):
+        for name, summary in out["series"].items():
+            if not isinstance(summary, dict):
+                errors.append(f"series.{name}: expected object")
+            else:
+                _check_flat_block(
+                    summary, SOAK_SERIES_KEYS, f"series.{name}", errors
+                )
+    if isinstance(out.get("scenarios"), dict):
+        for name, sc in out["scenarios"].items():
+            if not isinstance(sc, dict):
+                errors.append(f"scenarios.{name}: expected object")
+            else:
+                _check_flat_block(
+                    sc, SOAK_SCENARIO_KEYS, f"scenarios.{name}", errors
+                )
+    if isinstance(out.get("metrics"), dict):
+        _check_metrics_section(out["metrics"], errors)
+    faults = out.get("faults")
+    if isinstance(faults, dict):
+        for k in FAULT_KEYS:
+            if k not in faults:
+                errors.append(f"faults: missing series {k!r}")
+            elif not isinstance(faults[k], NUMBER):
+                errors.append(f"faults.{k}: expected number")
+        for k in faults:
+            if k not in FAULT_KEYS:
+                errors.append(
+                    f"faults: undocumented series {k!r} (add it to "
+                    "obs.registry.FAULT_SERIES, this schema, and PERF.md)"
+                )
+    return errors
 
 
 def _check_components(c: Optional[dict], where: str, errors: List[str]) -> None:
@@ -384,24 +560,32 @@ def main(argv: List[str]) -> int:
     else:
         with open(argv[1]) as f:
             text = f.read()
-    # bench.py prints exactly one JSON line on stdout, but a captured log
-    # may carry stderr noise: take the last line that parses as an object.
+    # Whole-document first (soak verdicts are written indented); bench.py
+    # prints exactly one JSON line on stdout, but a captured log may
+    # carry stderr noise around it: fall back to the last line that
+    # parses as an object.
     doc = None
-    for line in reversed([ln for ln in text.splitlines() if ln.strip()]):
-        try:
-            doc = json.loads(line)
-            break
-        except json.JSONDecodeError:
-            continue
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        for line in reversed([ln for ln in text.splitlines() if ln.strip()]):
+            try:
+                candidate = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(candidate, dict):
+                doc = candidate
+                break
     if doc is None:
         print("no JSON object found in input", file=sys.stderr)
         return 2
-    errors = validate(doc)
+    is_soak = looks_like_soak(doc)
+    errors = validate_soak(doc) if is_soak else validate(doc)
     if errors:
         for e in errors:
             print(f"SCHEMA: {e}", file=sys.stderr)
         return 1
-    print("bench schema OK")
+    print("soak schema OK" if is_soak else "bench schema OK")
     return 0
 
 

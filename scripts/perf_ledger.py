@@ -20,8 +20,10 @@ into one trajectory table plus a regression verdict:
   has the config (and vs ``--baseline`` when it carries numbers); a drop
   beyond ``--tolerance`` (default 15%) flags the (config, metric) --
   EXCEPT when either side of the comparison is marked
-  ``tunnel_degraded`` (environment noise must not fail the check; the
-  row is reported as excused instead).
+  ``tunnel_degraded``, or when the two rounds self-describe DIFFERENT
+  platforms (a cpu round after a tpu round is an environment change,
+  not a code regression). Environment noise must not fail the check;
+  such rows are reported as excused instead, with the excuse named.
 
 Usage:
     python scripts/perf_ledger.py BENCH_r*.json
@@ -152,6 +154,9 @@ def salvage_configs(tail: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         top["tunnel_mbps"] = (
             None if m.group(1) == "null" else float(m.group(1))
         )
+    m = re.search(r'"platform":\s*"([A-Za-z0-9_]+)"', tail)
+    if m is not None:
+        top["platform"] = m.group(1)
     return configs, top
 
 
@@ -165,6 +170,7 @@ def parse_artifact(doc: Any) -> Dict[str, Any]:
         return {
             "configs": doc["configs"],
             "tunnel_degraded": doc.get("tunnel_degraded"),
+            "platform": doc.get("platform"),
             "salvaged": False,
             "empty": not doc["configs"],
         }
@@ -174,6 +180,7 @@ def parse_artifact(doc: Any) -> Dict[str, Any]:
             return {
                 "configs": parsed["configs"],
                 "tunnel_degraded": parsed.get("tunnel_degraded"),
+                "platform": parsed.get("platform"),
                 "salvaged": False,
                 "empty": not parsed["configs"],
             }
@@ -182,11 +189,12 @@ def parse_artifact(doc: Any) -> Dict[str, Any]:
         return {
             "configs": configs,
             "tunnel_degraded": top.get("tunnel_degraded"),
+            "platform": top.get("platform"),
             "salvaged": bool(configs),
             "empty": not configs,
         }
-    return {"configs": {}, "tunnel_degraded": None, "salvaged": False,
-            "empty": True}
+    return {"configs": {}, "tunnel_degraded": None, "platform": None,
+            "salvaged": False, "empty": True}
 
 
 def load_artifact(path: str) -> Dict[str, Any]:
@@ -262,6 +270,13 @@ def delta_pct(prev: float, cur: float) -> Optional[float]:
     return (cur - prev) / prev * 100.0
 
 
+def platform_mismatch(a: Optional[str], b: Optional[str]) -> bool:
+    """Both sides' platforms known AND different: an environment change
+    (a cpu round after a tpu round), never excused on an unknown side --
+    the legacy truncated wrappers must not excuse themselves."""
+    return a is not None and b is not None and a != b
+
+
 def find_regressions(
     ledger: Dict[str, Any],
     rounds: List[Dict[str, Any]],
@@ -269,10 +284,13 @@ def find_regressions(
 ) -> List[Dict[str, Any]]:
     """Flag (config, metric, round) drops beyond `tolerance` vs the
     previous round carrying the metric. Entries where either side's
-    round is tunnel_degraded come back with ``"excused": True`` --
-    reported, never failed on."""
+    round is tunnel_degraded -- or the two rounds self-describe
+    DIFFERENT platforms (cpu vs tpu: an environment delta, not a code
+    regression) -- come back with ``"excused": True``: reported, never
+    failed on."""
     out: List[Dict[str, Any]] = []
     degraded = [bool(rec["tunnel_degraded"]) for rec in rounds]
+    platforms = [rec.get("platform") for rec in rounds]
     names = [rec["round"] for rec in rounds]
     for config, series in ledger["table"].items():
         for metric in REGRESSION_METRICS:
@@ -285,6 +303,11 @@ def find_regressions(
                     prev = vals[prev_i]
                     dp = delta_pct(prev, v)
                     if dp is not None and dp <= -tolerance * 100.0:
+                        excuse = None
+                        if degraded[i] or degraded[prev_i]:
+                            excuse = "tunnel_degraded"
+                        elif platform_mismatch(platforms[prev_i], platforms[i]):
+                            excuse = "platform_change"
                         out.append(
                             {
                                 "config": config,
@@ -294,7 +317,8 @@ def find_regressions(
                                 "prev": prev,
                                 "cur": v,
                                 "delta_pct": dp,
-                                "excused": degraded[i] or degraded[prev_i],
+                                "excused": excuse is not None,
+                                "excuse": excuse,
                             }
                         )
                 prev_i = i
@@ -318,7 +342,11 @@ def compare_artifacts(
         cur = parse_artifact(cur)
     deg_prev = bool(prev.get("tunnel_degraded"))
     deg_cur = bool(cur.get("tunnel_degraded"))
-    excused = deg_prev or deg_cur
+    plat_prev = prev.get("platform")
+    plat_cur = cur.get("platform")
+    excused = (
+        deg_prev or deg_cur or platform_mismatch(plat_prev, plat_cur)
+    )
     per_config: Dict[str, Any] = {}
     regressed = False
     # A config the prior carried that the current run LACKS is reported,
@@ -362,6 +390,8 @@ def compare_artifacts(
         "excused": excused and regressed,
         "tunnel_degraded_prev": deg_prev,
         "tunnel_degraded_cur": deg_cur,
+        "platform_prev": plat_prev,
+        "platform_cur": plat_cur,
     }
 
 
@@ -437,7 +467,8 @@ def render_table(
         lines.append("no unexcused regressions")
     for r in excused:
         lines.append(
-            f"  ~ excused (tunnel_degraded) {r['config']}.{r['metric']} "
+            f"  ~ excused ({r.get('excuse') or 'tunnel_degraded'}) "
+            f"{r['config']}.{r['metric']} "
             f"{r['prev_round']} -> {r['round']}: {r['delta_pct']:+.1f}%"
         )
     return "\n".join(lines)

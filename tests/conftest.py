@@ -62,4 +62,11 @@ def pytest_configure(config):
         "markers",
         "profiling: performance-observability tests (fast, CPU-safe)",
     )
+    # `soak` mirrors the other suite markers: rides tier-1 (the --quick
+    # soak is CI-sized by contract), and `pytest -m soak` selects the
+    # production-soak suite (scenario fleet, scraper, verdict gating).
+    config.addinivalue_line(
+        "markers",
+        "soak: production-soak suite (CI-sized --quick runs, CPU-safe)",
+    )
     config.addinivalue_line("markers", "slow: excluded from tier-1")

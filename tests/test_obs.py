@@ -539,6 +539,10 @@ def _valid_artifact():
             "excused": False,
             "tunnel_degraded_prev": False,
             "tunnel_degraded_cur": False,
+            # ISSUE 12: platform-change excusal self-description (None
+            # when the prior predates self-described platforms).
+            "platform_prev": None,
+            "platform_cur": "cpu",
         },
     }
 

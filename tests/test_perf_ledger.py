@@ -105,6 +105,61 @@ def test_tunnel_degraded_round_is_excused_either_side():
     assert len(regs) == 1 and regs[0]["excused"] is False
 
 
+def test_platform_change_excuses_regressions_both_directions():
+    """Rounds that self-describe DIFFERENT platforms (a cpu round after
+    a tpu round) report drops as excused -- an environment change is not
+    a code regression. Unknown platforms (legacy truncated wrappers)
+    never excuse themselves."""
+    rounds = [
+        _round("r1", {"c": _cfg(100_000.0)}),
+        _round("r2", {"c": _cfg(5_000.0)}),
+    ]
+    rounds[0]["platform"] = "tpu"
+    rounds[1]["platform"] = "cpu"
+    regs = find_regressions(build_ledger(rounds), rounds, tolerance=0.15)
+    assert len(regs) == 1
+    assert regs[0]["excused"] is True
+    assert regs[0]["excuse"] == "platform_change"
+    # One side unknown -> NOT excused.
+    rounds[0]["platform"] = None
+    regs = find_regressions(build_ledger(rounds), rounds, tolerance=0.15)
+    assert regs[0]["excused"] is False and regs[0]["excuse"] is None
+    # Same platform both sides -> NOT excused.
+    rounds[0]["platform"] = "cpu"
+    regs = find_regressions(build_ledger(rounds), rounds, tolerance=0.15)
+    assert regs[0]["excused"] is False
+
+
+def test_compare_artifacts_platform_fields_and_excusal():
+    prev = {"configs": {"c": _cfg(100_000.0)}, "platform": "tpu",
+            "tunnel_degraded": False}
+    cur = {"configs": {"c": _cfg(5_000.0)}, "platform": "cpu",
+           "tunnel_degraded": False}
+    block = compare_artifacts(prev, cur, tolerance=0.15)
+    assert block["regressed"] is True and block["excused"] is True
+    assert block["platform_prev"] == "tpu"
+    assert block["platform_cur"] == "cpu"
+    # Unknown prior platform (legacy wrapper): reported, not excused.
+    prev2 = {"configs": {"c": _cfg(100_000.0)}, "tunnel_degraded": False}
+    block2 = compare_artifacts(prev2, cur, tolerance=0.15)
+    assert block2["regressed"] is True and block2["excused"] is False
+    assert block2["platform_prev"] is None
+    # The augmented block still passes the artifact schema.
+    from test_obs import _valid_artifact
+
+    art = _valid_artifact()
+    art["regression"] = block
+    assert validate_bench_schema(art) == []
+
+
+def test_salvage_recovers_platform_from_truncated_tail():
+    tail = '"tunnel_degraded": false, "platform": "tpu", "configs": {'
+    _configs, top = salvage_configs(tail)
+    assert top["platform"] == "tpu"
+    rec = parse_artifact({"n": 1, "rc": 0, "tail": tail, "parsed": None})
+    assert rec["platform"] == "tpu"
+
+
 def test_host_suite_configs_tracked_via_nested_metrics():
     """Host-suite configs ({"host": {...}, "device_single": {...}}) show
     in the trajectory as host_eps/serde_eps/device_eps context columns --

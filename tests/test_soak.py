@@ -1,0 +1,484 @@
+"""Production soak plane (ISSUE 12): scenario fleet, self-scraped time
+series, SLO verdicts, and the chaos CLI entry point.
+
+Pins the acceptance contracts:
+- a CI-sized `--quick` soak (host fleet, seeded chaos, query churn,
+  self-scraping over real HTTP) PASSES, exits 0, and emits a
+  `SOAK_r*.json` verdict that `check_bench_schema.validate_soak`
+  accepts, carrying a min/max/last/slope series summary for every
+  SLO-gated metric;
+- a seeded violation (forced reorder-overflow drops) flips the verdict
+  to FAIL and the exit status to nonzero;
+- the verdict schema is enforced BOTH ways (missing documented keys AND
+  undocumented extras fail);
+- the adversarial generators are deterministic per seed and actually
+  adversarial (skew, storm phases, a stalled source);
+- the `faults.__main__` CLI parses, dispatches `soak`, exits correctly,
+  and wires `--http-port` (the satellite: it shipped since PR 6 with no
+  test);
+- /healthz carries the PR 9 event-time plane (watermark lag, reorder
+  occupancy) and DLQ-quarantine breakdowns.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+)
+from check_bench_schema import (  # noqa: E402
+    SOAK_SLOS,
+    looks_like_soak,
+    validate_soak,
+)
+
+from kafkastreams_cep_tpu.faults import __main__ as faults_cli  # noqa: E402
+from kafkastreams_cep_tpu.faults import soak  # noqa: E402
+from kafkastreams_cep_tpu.models.adversarial import (  # noqa: E402
+    KeySkewHotspot,
+    MatchStorm,
+    QueryChurnPlan,
+    WatermarkStall,
+)
+from kafkastreams_cep_tpu.obs import IntrospectionServer, MetricsRegistry  # noqa: E402
+from kafkastreams_cep_tpu.obs.scrape import MetricsScraper, TimeSeries  # noqa: E402
+
+pytestmark = pytest.mark.soak
+
+
+# ------------------------------------------------------------- time series
+def test_timeseries_slope_rate_and_summary():
+    ts = TimeSeries()
+    assert ts.slope_per_s() is None and ts.last is None
+    for i in range(10):
+        ts.append(float(i), 2.0 * i + 1.0)
+    assert ts.slope_per_s() == pytest.approx(2.0)
+    assert ts.rate_per_s() == pytest.approx(2.0)
+    s = ts.summary()
+    assert s == {
+        "n": 10, "min": 1.0, "max": 19.0, "last": 19.0, "slope_per_s": 2.0,
+    }
+    # Bounded ring: old samples roll off.
+    small = TimeSeries(maxlen=4)
+    for i in range(10):
+        small.append(float(i), float(i))
+    assert small.n == 4 and small.min == 6.0
+
+
+def test_timeseries_spike_fits_flatter_than_leak():
+    """The leak detector's core claim: a monotone climb fits its climb
+    rate; a spike that recovered fits far flatter AND nets zero."""
+    leak = TimeSeries()
+    spike = TimeSeries()
+    for i in range(20):
+        leak.append(float(i), float(i))          # climbs forever
+        spike.append(float(i), 19.0 if i == 10 else 1.0)
+    assert leak.slope_per_s() == pytest.approx(1.0)
+    assert abs(spike.slope_per_s()) < 0.2
+    assert spike.last == spike.min  # net growth zero: not a leak
+
+
+def test_scraper_aggregation_rules_and_rss():
+    """Counters (_total/_count/_sum/_bucket) fold by SUM across label
+    sets, gauges by MAX; RSS lands as process_rss_bytes."""
+    reg = MetricsRegistry()
+    c = reg.counter("cep_x_total", "x", labels=("q",))
+    c.labels(q="a").inc(3)
+    c.labels(q="b").inc(4)
+    g = reg.gauge("cep_lag_seconds", "lag", labels=("q",))
+    g.labels(q="a").set(2.0)
+    g.labels(q="b").set(5.0)
+    sc = MetricsScraper(registry=reg, every_s=10)
+    assert sc.scrape_once(now=1.0)
+    c.labels(q="a").inc(1)
+    assert sc.scrape_once(now=2.0)
+    assert sc.get("cep_x_total").last == 8.0
+    assert sc.get("cep_lag_seconds").last == 5.0
+    assert sc.get("process_rss_bytes") is not None
+    assert sc.get("process_rss_bytes").last > 0
+    assert sc.scrapes == 2 and sc.errors == 0
+
+
+def test_scraper_over_live_http_plane_and_error_counting():
+    reg = MetricsRegistry()
+    reg.counter("cep_live_total", "x").inc(7)
+    srv = IntrospectionServer(registry=reg, port=0).start()
+    url = srv.url
+    try:
+        sc = MetricsScraper(url=url, every_s=10)
+        assert sc.scrape_once()
+        assert sc.get("cep_live_total").last == 7.0
+    finally:
+        srv.stop()
+    # Dead endpoint: errors count, nothing raises into the caller.
+    dead = MetricsScraper(url=url, every_s=10, timeout_s=0.5)
+    assert not dead.scrape_once()
+    assert dead.errors == 1
+    with pytest.raises(ValueError):
+        MetricsScraper()  # neither url nor registry
+    with pytest.raises(ValueError):
+        MetricsScraper(url="http://x", registry=reg)  # both
+
+
+# ------------------------------------------------------ adversarial models
+def _stream_sig(gen, n=200):
+    return [(e.key, e.value, e.timestamp, e.topic) for e in gen.chunk(n)]
+
+
+@pytest.mark.parametrize("factory", [
+    lambda: KeySkewHotspot(11),
+    lambda: MatchStorm(12),
+    lambda: WatermarkStall(13, stall_after=80),
+])
+def test_generators_deterministic_per_seed(factory):
+    assert _stream_sig(factory()) == _stream_sig(factory())
+
+
+def test_hotspot_actually_skews():
+    gen = KeySkewHotspot(5, keys=8, hot_frac=0.9)
+    evs = gen.chunk(1000)
+    hot = sum(1 for e in evs if e.key == "h0")
+    assert hot > 800  # ~900 expected
+    assert len({e.key for e in evs}) == 8  # cold keys still trickle
+
+
+def test_match_storm_phases():
+    gen = MatchStorm(7, keys=2, quiet_len=50, storm_len=30)
+    evs = gen.chunk(200)
+    # Storm windows carry pure ABC cycles; quiet windows are mostly noise.
+    values = [e.value for e in evs]
+    quiet = values[:50]
+    storm = values[50:80]
+    assert all(v in "ABC" for v in storm)
+    per_key = {}
+    for e in evs[50:80]:
+        per_key.setdefault(e.key, []).append(e.value)
+    for seq in per_key.values():
+        assert "".join(seq).startswith("ABC")  # back-to-back full runs
+    assert sum(1 for v in quiet if v in "ABC") < 20
+
+
+def test_watermark_stall_source_goes_dark_and_bounded():
+    gen = WatermarkStall(9, sources=3, stall_source=0, stall_after=60)
+    evs = gen.chunk(60) + gen.chunk(120)
+    pre, post = evs[:60], evs[60:]
+    assert any(e.topic == "stall0" for e in pre)
+    assert not any(e.topic == "stall0" for e in post)
+    # Each source's own feed stays in event-time order...
+    by_src = {}
+    for e in evs:
+        by_src.setdefault(e.topic, []).append(e.timestamp)
+    for ts in by_src.values():
+        assert ts == sorted(ts)
+    # ...while the merged stream interleaves within the declared bound.
+    max_seen = -1
+    worst = 0
+    for e in evs:
+        worst = max(worst, max_seen - e.timestamp)
+        max_seen = max(max_seen, e.timestamp)
+    assert 0 < worst <= gen.reorder_bound_ms
+
+
+def test_query_churn_plan_deterministic_and_always_churns():
+    a = QueryChurnPlan(3, period_s=2.0)
+    b = QueryChurnPlan(3, period_s=2.0)
+    epochs = [a.live(i) for i in range(8)]
+    assert epochs == [b.live(i) for i in range(8)]
+    assert epochs[0] == a.queries  # epoch 0: everything live
+    for prev, cur in zip(epochs, epochs[1:]):
+        assert prev != cur  # every boundary is a real churn event
+    assert a.epoch_at(0.0) == 0 and a.epoch_at(5.0) == 2
+
+
+# ------------------------------------------------------------ the soak run
+@pytest.fixture(scope="module")
+def quick_soak(tmp_path_factory):
+    """One CI-sized soak (host fleet; ~4 s wall), shared by the verdict
+    and schema tests below."""
+    out = tmp_path_factory.mktemp("soak") / "SOAK_quick.json"
+    rc = soak.main([
+        "--quick", "--duration", "4", "--seed", "0", "--runtime", "host",
+        "--scrape-every", "0.25", "--out", str(out),
+    ])
+    with open(out) as f:
+        return rc, json.load(f)
+
+
+def test_quick_soak_passes_every_slo(quick_soak):
+    rc, doc = quick_soak
+    assert rc == 0
+    assert doc["passed"] is True
+    assert set(doc["slos"]) == set(SOAK_SLOS)
+    for name, entry in doc["slos"].items():
+        assert entry["ok"] is True, (name, entry)
+    s = doc["soak"]
+    assert s["events_produced"] > 0
+    assert s["events_processed"] == s["events_produced"]
+    assert s["matches"] > 0 and s["scrapes"] > 0
+    assert s["churn_epochs"] >= 1
+    # The fleet ran all three adversaries, and the gated one buffered.
+    assert set(doc["scenarios"]) == {
+        "hotspot", "match_storm", "watermark_stall",
+    }
+    assert all(sc["matches"] > 0 for sc in doc["scenarios"].values())
+    assert doc["scenarios"]["watermark_stall"]["gated"] is True
+
+
+def test_quick_soak_artifact_schema_and_series(quick_soak):
+    _rc, doc = quick_soak
+    assert looks_like_soak(doc)
+    assert validate_soak(doc) == []
+    assert doc.get("schema_ok") is True
+    # Every SLO-gated metric that moved carries the scraped summary a
+    # judge needs to tell a leak from a spike offline.
+    for name in (
+        "cep_watermark_lag_seconds",
+        "cep_reorder_occupancy",
+        "process_rss_bytes",
+        "cep_match_latency_seconds_count",
+    ):
+        summary = doc["series"][name]
+        assert set(summary) == {"n", "min", "max", "last", "slope_per_s"}
+        assert summary["n"] >= 3
+    # The stall scenario actually stalled: lag was observed nonzero.
+    assert doc["series"]["cep_watermark_lag_seconds"]["max"] > 0
+
+
+def test_validate_soak_enforces_both_ways(quick_soak):
+    _rc, doc = quick_soak
+    extra = dict(doc, bogus=1)
+    assert any("undocumented key 'bogus'" in e for e in validate_soak(extra))
+    missing = {k: v for k, v in doc.items() if k != "slos"}
+    assert any(
+        "missing documented key 'slos'" in e for e in validate_soak(missing)
+    )
+    # SLO set pinned exactly: dropping or inventing an SLO fails.
+    broken = json.loads(json.dumps(doc))
+    broken["slos"]["made_up"] = broken["slos"].pop("drops")
+    errs = validate_soak(broken)
+    assert any("missing SLO 'drops'" in e for e in errs)
+    assert any("undocumented SLO 'made_up'" in e for e in errs)
+    # Series summaries hold their documented shape.
+    broken2 = json.loads(json.dumps(doc))
+    next(iter(broken2["series"].values())).pop("slope_per_s")
+    assert any("slope_per_s" in e for e in validate_soak(broken2))
+
+
+def test_seeded_violation_flips_verdict(tmp_path):
+    out = tmp_path / "SOAK_violation.json"
+    rc = soak.main([
+        "--quick", "--duration", "2.5", "--seed", "0", "--runtime", "host",
+        "--violation", "drops", "--out", str(out),
+    ])
+    assert rc == 1
+    doc = json.loads(out.read_text())
+    assert doc["passed"] is False
+    assert doc["slos"]["drops"]["ok"] is False
+    assert doc["slos"]["drops"]["value"] > 0
+    assert doc["slos"]["drops"]["detail"][
+        "cep_reorder_overflow_dropped_total"
+    ] > 0
+    # A failing verdict is still a VALID artifact -- judges read it.
+    assert validate_soak(doc) == []
+    # The loss is visible in the scraped series too, not just the total.
+    assert doc["series"]["cep_reorder_overflow_dropped_total"]["last"] > 0
+
+
+def test_soak_regression_slo_against_prior_artifact(quick_soak, tmp_path):
+    """eps_regression reuses perf_ledger.compare_artifacts verbatim: a
+    fabricated fast prior flags, the soak's own prior does not."""
+    _rc, doc = quick_soak
+    fast_prior = tmp_path / "SOAK_fast.json"
+    boosted = json.loads(json.dumps(doc))
+    for sc in boosted["scenarios"].values():
+        sc["eps"] = sc["eps"] * 100.0
+    fast_prior.write_text(json.dumps(boosted))
+    block = soak._eps_regression_block(
+        str(fast_prior),
+        {
+            f"soak_{name}": {"eps": sc["eps"]}
+            for name, sc in doc["scenarios"].items()
+        },
+        platform=doc["soak"]["platform"],
+        tolerance=0.15,
+    )
+    assert block["regressed"] is True and block["excused"] is False
+    same_prior = tmp_path / "SOAK_same.json"
+    same_prior.write_text(json.dumps(doc))
+    block2 = soak._eps_regression_block(
+        str(same_prior),
+        {
+            f"soak_{name}": {"eps": sc["eps"]}
+            for name, sc in doc["scenarios"].items()
+        },
+        platform=doc["soak"]["platform"],
+        tolerance=0.15,
+    )
+    assert block2["regressed"] is False
+
+
+@pytest.mark.slow
+def test_mixed_runtime_soak_runs_device_fleet(tmp_path):
+    """The production fleet shape: the hotspot scenario on the DEVICE
+    runtime (slow-marked: the device engine compiles in-run; tier-1
+    covers the host fleet above). --leak-frac is explicit: a cold-cache
+    process compiling the engine mid-run grows RSS by design (the exact
+    effect PERF.md v15's SOAK_r01 section documents), and this test
+    pins the device-fleet wiring, not the leak bound."""
+    out = tmp_path / "SOAK_mixed.json"
+    rc = soak.main([
+        "--quick", "--duration", "6", "--seed", "1", "--runtime", "mixed",
+        "--leak-frac", "2.0", "--out", str(out),
+    ])
+    doc = json.loads(out.read_text())
+    assert doc["scenarios"]["hotspot"]["runtime"] == "tpu"
+    assert doc["scenarios"]["hotspot"]["matches"] > 0
+    assert validate_soak(doc) == []
+    assert rc == 0, doc["slos"]
+
+
+def test_soak_usage_errors_fail_fast(tmp_path):
+    """Usage-class mistakes exit 2 BEFORE burning soak wall-clock: a
+    violation run with no gated scenario in the fleet (it could never
+    fail, inverting the operator's intent), a typo'd --compare prior
+    (discovered at verdict time it would discard hours of evidence),
+    and an unknown scenario name."""
+    rc = soak.main([
+        "--quick", "--duration", "1", "--violation", "drops",
+        "--scenarios", "hotspot", "--runtime", "host",
+        "--out", str(tmp_path / "x.json"),
+    ])
+    assert rc == 2
+    rc = soak.main([
+        "--quick", "--duration", "1", "--runtime", "host",
+        "--compare", str(tmp_path / "no_such_prior.json"),
+        "--out", str(tmp_path / "y.json"),
+    ])
+    assert rc == 2
+    rc = soak.main([
+        "--quick", "--duration", "1", "--runtime", "host",
+        "--scenarios", "nonsense", "--out", str(tmp_path / "z.json"),
+    ])
+    assert rc == 2
+    assert not (tmp_path / "x.json").exists()
+
+
+def test_next_artifact_path_numbering(tmp_path):
+    assert soak.next_artifact_path(str(tmp_path)).endswith("SOAK_r01.json")
+    (tmp_path / "SOAK_r03.json").write_text("{}")
+    assert soak.next_artifact_path(str(tmp_path)).endswith("SOAK_r04.json")
+
+
+# ------------------------------------------------------- faults CLI entry
+def test_faults_cli_rejects_bad_args_and_offers_help(capsys):
+    with pytest.raises(SystemExit) as exc:
+        faults_cli.main(["--no-such-flag"])
+    assert exc.value.code == 2
+    with pytest.raises(SystemExit) as exc:
+        faults_cli.main(["--help"])
+    assert exc.value.code == 0
+    assert "--seeds" in capsys.readouterr().out
+    with pytest.raises(SystemExit) as exc:
+        faults_cli.main(["soak", "--no-such-flag"])
+    assert exc.value.code == 2
+
+
+def test_faults_cli_sweep_exit_zero_and_progress(capsys):
+    rc = faults_cli.main(["--seeds", "1", "--events", "12", "--points", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "seed 0:" in out and "1 seeds, 0 divergent" in out
+    # "sweep" is accepted as the explicit subcommand name.
+    rc = faults_cli.main(
+        ["sweep", "--seeds", "1", "--events", "12", "--points", "1"]
+    )
+    assert rc == 0
+
+
+def test_faults_cli_http_port_wiring(capsys):
+    rc = faults_cli.main([
+        "--seeds", "1", "--events", "12", "--points", "1",
+        "--http-port", "0",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "introspection plane: http://" in out
+
+
+def test_faults_cli_dispatches_soak_subcommand(tmp_path, capsys):
+    out = tmp_path / "SOAK_cli.json"
+    rc = faults_cli.main([
+        "soak", "--quick", "--duration", "1.5", "--seed", "2",
+        "--runtime", "host", "--scenarios", "hotspot,match_storm",
+        "--chaos-points", "0", "--out", str(out),
+    ])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert set(doc["scenarios"]) == {"hotspot", "match_storm"}
+    assert doc["soak"]["crashes"] == 0  # chaos disarmed
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line)["passed"] is True  # stdout JSON contract
+
+
+# -------------------------------------------------- /healthz event time
+def test_healthz_carries_event_time_plane_and_dlq_breakdown():
+    from kafkastreams_cep_tpu import (
+        ComplexStreamsBuilder,
+        LogDriver,
+        RecordLog,
+        produce,
+    )
+
+    reg = MetricsRegistry()
+    rlog = RecordLog()
+    builder = ComplexStreamsBuilder(log=rlog, app_id="hz-et")
+    builder.stream("src").query(
+        "gated", soak._letters_pattern(), registry=reg,
+        reorder_capacity=16, lateness_ms=50,
+    ).to("m")
+    topo = builder.build()
+    driver = LogDriver(topo, group="hz-et", registry=reg)
+    srv = driver.serve_http()
+    try:
+        # Out-of-order within the lateness bound: records buffer in the
+        # gate, so occupancy and lag are live when /healthz answers.
+        for ts, ch in ((100, "A"), (140, "B"), (120, "C")):
+            produce(rlog, "src", "K", ch, timestamp=ts)
+        driver.poll()
+        hz = json.loads(
+            urllib.request.urlopen(srv.url + "/healthz", timeout=10).read()
+        )
+        et = hz["event_time"]
+        assert et["gated_queries"] == 1
+        assert et["reorder_occupancy"] > 0
+        assert et["queries"]["gated"]["reorder_occupancy"] > 0
+        assert et["watermark_lag_s_max"] is not None
+        assert et["watermark_lag_s_max"] >= 0
+        assert hz["dead_letters_by_reason"] == {}
+    finally:
+        srv.stop()
+        driver.close()
+
+
+def test_healthz_event_time_zeros_without_gates():
+    from kafkastreams_cep_tpu import ComplexStreamsBuilder, LogDriver, RecordLog
+
+    rlog = RecordLog()
+    builder = ComplexStreamsBuilder(log=rlog, app_id="hz-plain")
+    builder.stream("src").query("plain", soak._letters_pattern()).to("m")
+    driver = LogDriver(builder.build(), group="hz-plain")
+    try:
+        et = driver.health()["event_time"]
+        assert et == {
+            "gated_queries": 0,
+            "reorder_occupancy": 0,
+            "watermark_lag_s_max": None,
+            "queries": {},
+        }
+    finally:
+        driver.close()
