@@ -1,0 +1,40 @@
+"""ceplint: invariant-enforcing static analysis for the CEP engine.
+
+The engine's hardest-won properties are behavioral contracts no type
+checker sees: the zero-sync advance path (NFA^b runs must branch without
+host round-trips), single-writer-or-locked shared state under the obs /
+driver / decode threads, stable jit caches across traffic churn, and
+serde frames that round-trip every field of every checkpointed
+structure. Each has already produced a real production bug (SOAK_r01's
+churn-recompile RSS leak; PR 9's gate-state atomicity bug) that hand
+review missed -- this package turns those invariant classes into
+machine-checked lints over the stdlib `ast`.
+
+Checkers (each with a seeded mutation fixture under tests/fixtures/lint/
+proving it can fail):
+
+- ``zerosync``  host-sync constructs inside hot-path functions
+- ``threads``   attributes written from >= 2 thread roots outside a lock
+- ``recompile`` jit-cache hazards (jit-in-loop, mutable static args,
+                closures over mutable state)
+- ``serde``     checkpoint field round-trip completeness
+- ``metrics``   cep_* metric names vs the PERF.md dictionary
+
+Audited sites are annotated in source with the pragma grammar
+``# cep: <kind>(<reason>)`` (see analysis/core.py); residual accepted
+findings live in the committed ``ceplint.baseline.json``. The CLI is
+``scripts/ceplint.py``; ``tests/test_lint.py`` runs the whole gate in
+tier-1. Runtime companions: ``analysis/lockmon.py`` (instrumented-lock
+lock-order cycle detection, armed in the chaos and quick-soak tests) and
+``analysis/jit_audit.py`` (replays a churn epoch and asserts
+``cep_compiles_total{fn}`` stays flat for unchanged shapes -- SOAK_r01's
+leak class as a red test).
+"""
+from .core import (  # noqa: F401
+    Finding,
+    Pragma,
+    SourceFile,
+    iter_source_files,
+    run_checkers,
+    CHECKERS,
+)

@@ -1,0 +1,119 @@
+"""jit-cache audit: replay a churn epoch, assert zero recompiles.
+
+The runtime half of the recompile checker (analysis/recompile.py is the
+static half). SOAK_r01 measured the failure mode this guards: query
+churn drove `cep_compiles_total{fn}` (and RSS, 358 MB -> 1.3 GB) up
+monotonically even though traffic shapes never changed. The audit
+builds a small batched engine with CompileWatch armed, runs one warmup
+epoch (every jitted entry point -- advance, append, flush, probes,
+flatten -- sees its shapes and compiles), snapshots the per-fn compile
+counters, then replays further epochs of the *same shapes* including
+drains, checkpoint/restore round-trips, and a fault-free re-pack of
+identical traffic. Any counter that moves is a finding (CEP-J01): a
+compile fired for a shape signature the cache had already paid for.
+
+Imports jax (unlike every static checker); `ceplint --jit-audit` and
+tests/test_lint.py are the callers.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+from .core import Finding
+
+# Same backend pinning as tests/conftest.py and faults/soak.py: the
+# audit is a CPU-correctness replay, and the axon PJRT plugin hangs the
+# process at backend init when the TPU tunnel is down. (No-op once jax
+# is already initialized -- pytest runs are pinned by conftest anyway.)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+__all__ = ["run_jit_cache_audit"]
+
+
+def _compile_counts(registry) -> Dict[str, float]:
+    snap = registry.snapshot()
+    out: Dict[str, float] = {}
+    for val in snap.get("cep_compiles_total", {}).get("values", []):
+        labels = dict(val.get("labels", {}))
+        out[labels.get("fn", "?")] = float(val.get("value", 0))
+    return out
+
+
+def run_jit_cache_audit(
+    epochs: int = 2,
+    batches_per_epoch: int = 4,
+    engine: str = "xla",
+    vary_shapes: bool = False,
+) -> List[Finding]:
+    """Findings (empty = pass) for the same-shape churn replay.
+
+    `vary_shapes=True` is the seeded violation (tests/test_lint.py):
+    each post-warmup epoch grows the batch length, so new [T, K]
+    signatures MUST compile and the audit MUST report -- proving the
+    gate can fail."""
+    from ..core.event import Event
+    from ..models.letters import letters_pattern
+    from ..obs.registry import MetricsRegistry
+    from ..ops.engine import EngineConfig
+    from ..ops.tables import compile_query
+    from ..pattern.compiler import compile_pattern
+    from ..parallel.batched import BatchedDeviceNFA
+
+    registry = MetricsRegistry()
+    query = compile_query(compile_pattern(letters_pattern()), None)
+    bat = BatchedDeviceNFA(
+        query,
+        keys=["k0", "k1"],
+        config=EngineConfig(lanes=8, nodes=128, matches=64),
+        engine=engine,
+        registry=registry,
+        compile_telemetry=True,
+    )
+
+    def epoch(base_offset: int, extra_t: int = 0) -> None:
+        """One traffic epoch: fixed [T, K] shapes, a match-bearing mix,
+        and a terminal drain -- the steady-state serving shape.
+        `extra_t` pads the batch length (the seeded shape churn)."""
+        letters = "ABCZ" + "Z" * extra_t
+        for b in range(batches_per_epoch):
+            off = base_offset + b * len(letters)
+            evs = {
+                key: [
+                    Event(key, v, 1_000_000 + off + i, "t", 0, off + i)
+                    for i, v in enumerate(letters)
+                ]
+                for key in ("k0", "k1")
+            }
+            bat.advance(evs)
+        bat.drain()
+
+    epoch(0)  # warmup: every entry point compiles here
+    # Snapshot forces a group flush -- the checkpoint path must ride the
+    # same warm programs. (BatchedDeviceNFA.restore() builds a FRESH
+    # engine and recompiles by design today; making that warm is ROADMAP
+    # item 3's compile cache, not this audit's contract.)
+    bat.snapshot()
+    warm = _compile_counts(registry)
+    findings: List[Finding] = []
+    for e in range(1, epochs + 1):
+        extra = e if vary_shapes else 0
+        epoch(e * 1000, extra_t=extra)
+        bat.snapshot()
+        epoch((e + 1) * 1000 + 500, extra_t=extra)
+        now = _compile_counts(registry)
+        for fn, count in sorted(now.items()):
+            if count > warm.get(fn, 0):
+                findings.append(
+                    Finding(
+                        "jit-audit", "CEP-J01",
+                        "kafkastreams_cep_tpu/parallel/batched.py", 0,
+                        f"cep_compiles_total{{fn={fn}}} rose "
+                        f"{warm.get(fn, 0):.0f} -> {count:.0f} during "
+                        f"same-shape churn epoch {e} -- the jit cache "
+                        "did not stay warm (SOAK_r01's leak class)",
+                        context=f"jit-audit:{fn}:epoch{e}",
+                    )
+                )
+        warm = now  # report each epoch's delta once
+    return findings

@@ -1,0 +1,126 @@
+"""metrics: every cep_* metric in code <-> the PERF.md dictionary.
+
+The observability spine is only as trustworthy as its documentation: a
+metric emitted but undocumented is invisible to operators; a documented
+metric that no code emits is a dashboard that silently reads empty.
+This checker extracts every ``cep_*`` name registered through the obs
+registry constructors (``.counter(...)``/``.gauge(...)``/
+``.histogram(...)``) and diffs it both ways against the authoritative
+dictionary section of PERF.md, delimited by::
+
+    <!-- ceplint:metrics-dictionary:begin -->
+    ...one `cep_name{labels}` per entry...
+    <!-- ceplint:metrics-dictionary:end -->
+
+Findings:
+    CEP-M01  metric registered in code but absent from the dictionary
+    CEP-M02  dictionary entry no code registers (stale doc)
+    CEP-M03  PERF.md or its dictionary markers missing
+
+Code-side exceptions carry ``# cep: metric-ok(reason)``; doc-side
+findings have no comment channel and go through the baseline.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding, SourceFile
+
+PERF_PATH = "PERF.md"
+BEGIN = "<!-- ceplint:metrics-dictionary:begin -->"
+END = "<!-- ceplint:metrics-dictionary:end -->"
+_NAME_RE = re.compile(r"`(cep_[a-z0-9_]+)")
+_REG_METHODS = {"counter", "gauge", "histogram"}
+
+
+def code_metrics(files: Sequence[SourceFile]) -> Dict[str, List[Tuple[str, int]]]:
+    """{metric name: [(relpath, line)]} from registry constructor calls."""
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for src in files:
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REG_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("cep_")
+            ):
+                out.setdefault(node.args[0].value, []).append(
+                    (src.relpath, node.lineno)
+                )
+    return out
+
+
+def doc_metrics(root_dir: str) -> Tuple[Dict[str, int], List[Finding]]:
+    """{name: first line} from the PERF.md dictionary section."""
+    path = os.path.join(root_dir, PERF_PATH)
+    if not os.path.exists(path):
+        return {}, [
+            Finding(
+                "metrics", "CEP-M03", PERF_PATH, 0,
+                "PERF.md not found -- the metrics dictionary is the "
+                "completeness checker's source of truth",
+                context="perf-md-missing",
+            )
+        ]
+    with open(path, "r", encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    begin = end = None
+    for i, line in enumerate(lines, 1):
+        if BEGIN in line and begin is None:
+            begin = i
+        elif END in line and begin is not None:
+            end = i
+            break
+    if begin is None or end is None:
+        return {}, [
+            Finding(
+                "metrics", "CEP-M03", PERF_PATH, 0,
+                f"metrics dictionary markers missing ({BEGIN} ... {END}) "
+                "-- add the authoritative section",
+                context="dictionary-markers-missing",
+            )
+        ]
+    names: Dict[str, int] = {}
+    for i in range(begin, end - 1):
+        for m in _NAME_RE.finditer(lines[i]):
+            names.setdefault(m.group(1), i + 1)
+    return names, []
+
+
+def check(files: Sequence[SourceFile], root_dir: str) -> List[Finding]:
+    in_code = code_metrics(files)
+    in_doc, findings = doc_metrics(root_dir)
+    if findings:
+        return findings
+    # Partial runs (a file subset) must not claim doc entries are stale.
+    full_scan = any(
+        src.relpath == "kafkastreams_cep_tpu/obs/registry.py"
+        for src in files
+    )
+    for name in sorted(set(in_code) - set(in_doc)):
+        path, line = in_code[name][0]
+        findings.append(
+            Finding(
+                "metrics", "CEP-M01", path, line,
+                f"metric {name} is registered here but absent from the "
+                "PERF.md metrics dictionary",
+                context=f"metric:{name}",
+            )
+        )
+    if full_scan:
+        for name in sorted(set(in_doc) - set(in_code)):
+            findings.append(
+                Finding(
+                    "metrics", "CEP-M02", PERF_PATH, in_doc[name],
+                    f"dictionary entry {name} is registered by no code "
+                    "(stale doc entry)",
+                    context=f"metric:{name}",
+                )
+            )
+    return findings

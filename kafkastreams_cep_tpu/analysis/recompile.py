@@ -1,0 +1,424 @@
+"""recompile: jit-cache hazards in the builder layer.
+
+SOAK_r01's churn-recompile RSS leak (358 MB -> 1.3 GB over 240 s) was a
+whole class of bug: a jit cache that cannot stay warm across identical
+shapes. The static half of the gate flags the constructs that produce
+that class; the runtime half (analysis/jit_audit.py) replays a
+same-shape churn epoch and asserts ``cep_compiles_total{fn}`` stays
+flat.
+
+Findings:
+    CEP-R01  jax.jit inside a for/while loop body -- a fresh cache per
+             iteration, nothing ever warm
+    CEP-R02  jax.jit inside a hot-path function -- a fresh cache per
+             call on the advance path
+    CEP-R03  mutable/unhashable static arg: static_argnums/argnames
+             naming a parameter with a mutable default, or a package
+             call site passing a list/dict/set for a static parameter
+    CEP-R04  jitted closure over mutable state: the traced inner
+             function reads ``self.X`` or a module-level mutable --
+             mutation after the first trace silently never retraces
+    CEP-R05  closure capture rebound after the jit wrap in the same
+             builder -- the trace keeps the old binding
+
+Builders are ``build_*`` functions (the repo convention, also the hot
+set in zerosync.HOT_PATHS); CEP-R04/R05 apply inside any function that
+wraps an inner def with jit. Audited sites carry
+``# cep: static-ok(reason)``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile, dotted_name as _dotted
+from .zerosync import function_index, hot_functions
+
+
+def _is_jit(node: ast.AST) -> bool:
+    dotted = _dotted(node)
+    return dotted in ("jax.jit", "jit") or (
+        dotted is not None and dotted.endswith(".jit")
+    )
+
+
+def _jit_calls(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit(node.func):
+            yield node
+
+
+def _mutable_display(node: ast.AST) -> bool:
+    return isinstance(
+        node, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp)
+    )
+
+
+# ---------------------------------------------------------------------------
+# module-level mutable globals (for CEP-R04)
+# ---------------------------------------------------------------------------
+def _mutable_globals(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        if _mutable_display(value):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-function analysis
+# ---------------------------------------------------------------------------
+def _local_names(fn: ast.AST) -> Set[str]:
+    """Names bound in `fn`'s own scope (params, assignments, defs)."""
+    out: Set[str] = set()
+    args = fn.args
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+    ):
+        out.add(a.arg)
+    if args.vararg:
+        out.add(args.vararg.arg)
+    if args.kwarg:
+        out.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        if node is fn:
+            continue
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name):
+                        out.add(leaf.id)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            for leaf in ast.walk(node.target):
+                if isinstance(leaf, ast.Name):
+                    out.add(leaf.id)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            tgt = node.target
+            for leaf in ast.walk(tgt):
+                if isinstance(leaf, ast.Name):
+                    out.add(leaf.id)
+    return out
+
+
+def _inner_defs(fn: ast.AST) -> Dict[str, ast.AST]:
+    return {
+        n.name: n
+        for n in ast.walk(fn)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n is not fn
+    }
+
+
+def _jitted_inner_fns(fn: ast.AST) -> List[Tuple[ast.AST, int]]:
+    """Inner defs wrapped by jit within `fn`: @jax.jit decorated, or
+    referenced by name in a jax.jit(...) call. Returns (def, jit line)."""
+    inner = _inner_defs(fn)
+    out: List[Tuple[ast.AST, int]] = []
+    for name, node in inner.items():
+        for deco in node.decorator_list:
+            target = deco.func if isinstance(deco, ast.Call) else deco
+            if _is_jit(target):
+                out.append((node, deco.lineno))
+    for call in _jit_calls(fn):
+        for arg in call.args[:1]:
+            if isinstance(arg, ast.Name) and arg.id in inner:
+                out.append((inner[arg.id], call.lineno))
+    return out
+
+
+def _check_static_args(
+    src: SourceFile, fn_index: Dict[str, ast.AST], files_calls
+) -> List[Finding]:
+    """CEP-R03: static_argnums/static_argnames hazards."""
+    findings: List[Finding] = []
+    inner_by_name = {}
+    for qual, fn in fn_index.items():
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner_by_name[node.name] = node
+    for call in _jit_calls(src.tree):
+        static_names: List[str] = []
+        static_nums: List[int] = []
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for leaf in ast.walk(kw.value):
+                    if isinstance(leaf, ast.Constant) and isinstance(
+                        leaf.value, str
+                    ):
+                        static_names.append(leaf.value)
+            elif kw.arg == "static_argnums":
+                for leaf in ast.walk(kw.value):
+                    if isinstance(leaf, ast.Constant) and isinstance(
+                        leaf.value, int
+                    ):
+                        static_nums.append(leaf.value)
+        if not static_names and not static_nums:
+            continue
+        target = call.args[0] if call.args else None
+        target_def = None
+        if isinstance(target, ast.Name):
+            target_def = inner_by_name.get(target.id)
+        elif isinstance(target, (ast.FunctionDef,)):  # pragma: no cover
+            target_def = target
+        if target_def is None:
+            continue
+        args = target_def.args
+        params = list(args.posonlyargs) + list(args.args)
+        defaults = list(args.defaults)
+        # defaults align to the tail of params
+        by_name = {p.arg: i for i, p in enumerate(params)}
+        static_idx = set(static_nums)
+        static_idx.update(
+            by_name[n] for n in static_names if n in by_name
+        )
+        for i in sorted(static_idx):
+            di = i - (len(params) - len(defaults))
+            if 0 <= di < len(defaults) and _mutable_display(defaults[di]):
+                findings.append(
+                    Finding(
+                        "recompile", "CEP-R03", src.relpath, call.lineno,
+                        f"static arg {params[i].arg!r} of jitted "
+                        f"{target_def.name!r} has a mutable default -- "
+                        "unhashable statics retrace (or raise) per call",
+                        context=src.context_line(call.lineno),
+                    )
+                )
+        # package call sites passing mutable displays for static params
+        for csrc, ccall in files_calls:
+            fname = _dotted(ccall.func) or ""
+            if fname.split(".")[-1] != target_def.name:
+                continue
+            for i in sorted(static_idx):
+                if i < len(ccall.args) and _mutable_display(ccall.args[i]):
+                    findings.append(
+                        Finding(
+                            "recompile", "CEP-R03", csrc.relpath,
+                            ccall.lineno,
+                            f"call passes a mutable display for static "
+                            f"arg {params[i].arg!r} of jitted "
+                            f"{target_def.name!r} -- unhashable statics "
+                            "retrace (or raise) per call",
+                            context=csrc.context_line(ccall.lineno),
+                        )
+                    )
+            for kw in ccall.keywords:
+                if kw.arg in static_names and _mutable_display(kw.value):
+                    findings.append(
+                        Finding(
+                            "recompile", "CEP-R03", csrc.relpath,
+                            ccall.lineno,
+                            f"call passes a mutable display for static "
+                            f"arg {kw.arg!r} of jitted "
+                            f"{target_def.name!r}",
+                            context=csrc.context_line(ccall.lineno),
+                        )
+                    )
+    return findings
+
+
+def check(files: Sequence[SourceFile], root_dir: str) -> List[Finding]:
+    findings: List[Finding] = []
+    all_calls = [
+        (src, node)
+        for src in files
+        for node in ast.walk(src.tree)
+        if isinstance(node, ast.Call)
+    ]
+    for src in files:
+        # Most modules never touch jax.jit; one cheap walk skips them.
+        if not any(
+            isinstance(n, ast.Call) and _is_jit(n.func)
+            for n in ast.walk(src.tree)
+        ):
+            continue
+        fn_index = function_index(src)
+        mutable_globals = _mutable_globals(src.tree)
+        hot_roots, _stale = hot_functions(src)
+        findings.extend(_check_static_args(src, fn_index, all_calls))
+
+        # ------------------------------------------------- R01: jit in a loop
+        class _LoopJit(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.depth = 0
+                self.qual: List[str] = []
+
+            def _fn(self, node):
+                self.qual.append(node.name)
+                depth, self.depth = self.depth, 0
+                self.generic_visit(node)
+                self.depth = depth
+                self.qual.pop()
+
+            visit_FunctionDef = _fn
+            visit_AsyncFunctionDef = _fn
+
+            def visit_ClassDef(self, node):
+                self.qual.append(node.name)
+                self.generic_visit(node)
+                self.qual.pop()
+
+            def _loop(self, node):
+                self.depth += 1
+                self.generic_visit(node)
+                self.depth -= 1
+
+            visit_For = _loop
+            visit_While = _loop
+
+            def visit_Call(self, node):
+                if _is_jit(node.func) and self.depth > 0:
+                    findings.append(
+                        Finding(
+                            "recompile", "CEP-R01", src.relpath,
+                            node.lineno,
+                            "jax.jit inside a loop in "
+                            f"{'.'.join(self.qual) or '<module>'}: a fresh "
+                            "jit cache per iteration never stays warm",
+                            context=src.context_line(node.lineno),
+                        )
+                    )
+                self.generic_visit(node)
+
+        _LoopJit().visit(src.tree)
+
+        # ------------------------------------------------ R02: jit in hot path
+        # Builders (build_*) are the sanctioned construction points --
+        # called once per engine, not per advance (the jit-cache audit
+        # catches a builder that churns at runtime). A jit under an
+        # ``if <attr> is None`` memo guard is one-time by construction.
+        for qual, fn in hot_roots.items():
+            if qual.rsplit(".", 1)[-1].startswith("build_"):
+                continue
+            memo_guarded: Set[int] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.If) and (
+                    isinstance(node.test, ast.Compare)
+                    and any(
+                        isinstance(op, ast.Is) for op in node.test.ops
+                    )
+                    and any(
+                        isinstance(c, ast.Constant) and c.value is None
+                        for c in node.test.comparators
+                    )
+                ):
+                    for sub in ast.walk(node):
+                        memo_guarded.add(id(sub))
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and _is_jit(node.func)
+                    and id(node) not in memo_guarded
+                ):
+                    findings.append(
+                        Finding(
+                            "recompile", "CEP-R02", src.relpath, node.lineno,
+                            f"jax.jit constructed inside hot-path {qual}: "
+                            "a fresh cache per call on the advance path",
+                            context=src.context_line(node.lineno),
+                        )
+                    )
+
+        # -------------------------------------- R04/R05: closure captures
+        # fn_index carries nested defs as their own entries; the seen set
+        # keeps a deeply-nested jitted fn from double-reporting through
+        # every enclosing level.
+        seen_inner: Set[Tuple[int, int]] = set()
+        for qual, fn in fn_index.items():
+            jitted = [
+                (inner, line)
+                for inner, line in _jitted_inner_fns(fn)
+                if (inner.lineno, line) not in seen_inner
+            ]
+            if not jitted:
+                continue
+            seen_inner.update((inner.lineno, line) for inner, line in jitted)
+            builder_locals = _local_names(fn)
+            for inner, jit_line in jitted:
+                inner_locals = _local_names(inner)
+                reads_self = any(
+                    isinstance(n, ast.Name) and n.id == "self"
+                    for n in ast.walk(inner)
+                )
+                if reads_self and "self" not in inner_locals:
+                    findings.append(
+                        Finding(
+                            "recompile", "CEP-R04", src.relpath,
+                            inner.lineno,
+                            f"jitted {qual}.{inner.name} closes over self: "
+                            "instance state is baked into the trace and "
+                            "mutation never retraces",
+                            context=src.context_line(inner.lineno),
+                        )
+                    )
+                captured_globals = {
+                    n.id
+                    for n in ast.walk(inner)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)
+                    and n.id in mutable_globals
+                    and n.id not in inner_locals
+                    and n.id not in builder_locals
+                }
+                for name in sorted(captured_globals):
+                    findings.append(
+                        Finding(
+                            "recompile", "CEP-R04", src.relpath,
+                            inner.lineno,
+                            f"jitted {qual}.{inner.name} closes over "
+                            f"module-level mutable {name!r}: mutation "
+                            "after the first trace never retraces",
+                            context=src.context_line(inner.lineno),
+                        )
+                    )
+                # R05: capture rebound after the jit wrap
+                captured = {
+                    n.id
+                    for n in ast.walk(inner)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)
+                    and n.id in builder_locals
+                    and n.id not in inner_locals
+                }
+                for node in ast.walk(fn):
+                    if (
+                        isinstance(node, (ast.Assign, ast.AugAssign))
+                        and node.lineno > jit_line
+                    ):
+                        targets = (
+                            node.targets
+                            if isinstance(node, ast.Assign)
+                            else [node.target]
+                        )
+                        for t in targets:
+                            if (
+                                isinstance(t, ast.Name)
+                                and t.id in captured
+                            ):
+                                findings.append(
+                                    Finding(
+                                        "recompile", "CEP-R05",
+                                        src.relpath, node.lineno,
+                                        f"{t.id!r} is captured by jitted "
+                                        f"{qual}.{inner.name} but rebound "
+                                        "after the jit wrap -- the trace "
+                                        "keeps the old binding",
+                                        context=src.context_line(
+                                            node.lineno
+                                        ),
+                                    )
+                                )
+    return findings

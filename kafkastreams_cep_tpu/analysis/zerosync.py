@@ -1,0 +1,348 @@
+"""zerosync: host-sync constructs inside hot-path functions.
+
+The NFA^b advance contract (PAPER.md; SASE NFA^b, Agrawal et al.
+SIGMOD'08): every per-batch branch decision happens on device, so an
+advance must dispatch without a host round-trip. A single stray
+``float(traced)`` or ``np.asarray(device_array)`` turns the pipelined
+advance into a lockstep one -- tests/test_obs.py pins the behavior at
+runtime for one configuration; this checker pins the *construct* for
+every hot-path function on every path.
+
+Hot-path functions are declared two ways:
+
+- the ``HOT_PATHS`` table below (fnmatch patterns over qualnames) -- the
+  repo's own hot set, centrally auditable. A pattern that stops matching
+  anything is itself a finding (CEP-S04), so the table cannot rot.
+- a ``# cep: hot-path`` pragma on (or directly above) a ``def`` line --
+  how out-of-tree and fixture code opts in.
+
+Nested functions inherit hotness from their enclosing hot function.
+
+Findings:
+    CEP-S01  sync tell: .item()/.tolist()/block_until_ready/device_get,
+             or np.asarray/np.array on a traced-looking value
+    CEP-S02  host scalarization: float()/int()/bool() on a traced value
+    CEP-S03  traced-value truthiness in if/while/assert/and/or/not
+    CEP-S04  stale HOT_PATHS entry (pattern matches nothing)
+
+"Traced-looking" is a local dataflow approximation: parameters with
+array-carrying names (state, pool, xs, ...), results of jnp./jax.lax.
+calls and of the engine's compiled-dispatch attributes, and anything
+derived from them by arithmetic, subscripting, or method chaining.
+``.shape``/``.dtype``/``.ndim``/``.size`` access exits the traced set
+(static metadata is host-safe). Audited sites carry
+``# cep: sync-ok(reason)``.
+"""
+from __future__ import annotations
+
+import ast
+from fnmatch import fnmatch
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile, dotted_name as _dotted
+
+#: repo-relative file -> qualname fnmatch patterns of hot-path functions.
+HOT_PATHS: Dict[str, Tuple[str, ...]] = {
+    "kafkastreams_cep_tpu/ops/engine.py": ("build_*",),
+    "kafkastreams_cep_tpu/ops/pallas_step.py": ("build_*",),
+    "kafkastreams_cep_tpu/ops/runtime.py": (
+        "DeviceNFA.advance",
+        "DeviceNFA._flush_group",
+    ),
+    "kafkastreams_cep_tpu/parallel/batched.py": (
+        "BatchedDeviceNFA.pack",
+        "BatchedDeviceNFA.advance",
+        "BatchedDeviceNFA.advance_packed",
+        "BatchedDeviceNFA._flush_group",
+        "BatchedDeviceNFA._dispatch_pos_probe",
+        "BatchedDeviceNFA._occupancy_bound",
+    ),
+    "kafkastreams_cep_tpu/parallel/key_shard.py": (
+        "build_batched_*",
+        "shard_state",
+        "shard_xs",
+    ),
+}
+
+#: parameter names seeded as traced (the engine's array-carrying names).
+ARRAY_PARAMS = {
+    "state", "pool", "xs", "ys", "xi", "xt", "xs_t", "pend", "carry",
+    "leaf", "tree", "arrays",
+}
+#: attribute access that *exits* the traced set (static metadata).
+META_ATTRS = {"shape", "dtype", "ndim", "size", "at"}
+#: dotted-call prefixes whose results are traced values.
+ARRAY_CALL_PREFIXES = (
+    "jnp.", "jax.numpy.", "jax.lax.", "jax.nn.", "lax.",
+)
+#: substrings of ``self._X(...)`` callees that return device values
+#: (the compiled-dispatch attributes: self._advance, self._append, ...).
+DISPATCH_HINTS = ("advance", "append", "flush", "post", "step", "probe")
+#: method calls that keep a traced receiver traced.
+_CHAIN_METHODS = {
+    "sum", "min", "max", "mean", "astype", "reshape", "ravel", "any",
+    "all", "copy", "take", "dot", "cumsum", "argmax", "argmin", "clip",
+    "transpose", "squeeze",
+}
+#: always a sync when called as a method in a hot function.
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+class _FunctionIndex(ast.NodeVisitor):
+    """qualname -> def node for every function in a module."""
+
+    def __init__(self) -> None:
+        self.functions: Dict[str, ast.AST] = {}
+        self._stack: List[str] = []
+
+    def _visit_def(self, node) -> None:
+        self._stack.append(node.name)
+        self.functions[".".join(self._stack)] = node
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+
+def function_index(src: SourceFile) -> Dict[str, ast.AST]:
+    idx = _FunctionIndex()
+    idx.visit(src.tree)
+    return idx.functions
+
+
+def hot_functions(src: SourceFile) -> Tuple[Dict[str, ast.AST], List[str]]:
+    """(qualname -> def node of hot roots, stale HOT_PATHS patterns)."""
+    funcs = function_index(src)
+    hot: Dict[str, ast.AST] = {}
+    stale: List[str] = []
+    for pattern in HOT_PATHS.get(src.relpath, ()):
+        matched = False
+        for qual, node in funcs.items():
+            if fnmatch(qual, pattern):
+                hot[qual] = node
+                matched = True
+        if not matched:
+            stale.append(pattern)
+    for qual, node in funcs.items():
+        line = node.lineno
+        deco_first = min(
+            [d.lineno for d in getattr(node, "decorator_list", [])] + [line]
+        )
+        if (
+            src.has_marker(line, "hot-path")
+            or src.has_marker(deco_first - 1, "hot-path")
+        ):
+            hot[qual] = node
+    # Nested functions are visited through their parent; keep roots only.
+    roots = {
+        qual: node
+        for qual, node in hot.items()
+        if not any(qual != q and qual.startswith(q + ".") for q in hot)
+    }
+    return roots, stale
+
+
+class _TracedEnv:
+    """Forward-pass approximation of names bound to traced values."""
+
+    def __init__(self, fn: ast.AST) -> None:
+        self.names: Set[str] = set()
+        # Seed from the root AND every nested def: inner jitted bodies
+        # (build_* closures) carry the array params.
+        for node in ast.walk(fn):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                args = node.args
+                for a in (
+                    list(args.posonlyargs)
+                    + list(args.args)
+                    + list(args.kwonlyargs)
+                ):
+                    if a.arg in ARRAY_PARAMS:
+                        self.names.add(a.arg)
+
+    # ------------------------------------------------------------ expression
+    def traced(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in META_ATTRS:
+                return False
+            return self.traced(node.value)
+        if isinstance(node, ast.Subscript):
+            if self.traced(node.value):
+                return True
+            base = node.value
+            return isinstance(base, ast.Name) and base.id in ARRAY_PARAMS
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            if dotted is not None:
+                if dotted.startswith(ARRAY_CALL_PREFIXES):
+                    return True
+                if dotted.startswith("self._") and any(
+                    h in dotted for h in DISPATCH_HINTS
+                ):
+                    return True
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CHAIN_METHODS
+            ):
+                return self.traced(node.func.value)
+            return False
+        if isinstance(node, (ast.BinOp,)):
+            return self.traced(node.left) or self.traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.traced(node.operand)
+        if isinstance(node, ast.Compare):
+            # Membership and identity tests on a columns dict are host
+            # pytree-key operations, not device comparisons.
+            if all(
+                isinstance(op, (ast.In, ast.NotIn, ast.Is, ast.IsNot))
+                for op in node.ops
+            ):
+                return False
+            return self.traced(node.left) or any(
+                self.traced(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return self.traced(node.body) or self.traced(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.traced(e) for e in node.elts)
+        if isinstance(node, (ast.DictComp, ast.ListComp, ast.SetComp)):
+            return any(
+                self.traced(sub)
+                for sub in ast.walk(node)
+                if isinstance(sub, ast.Call)
+            )
+        return False
+
+    def bind(self, target: ast.AST, traced: bool) -> None:
+        if isinstance(target, ast.Name):
+            if traced:
+                self.names.add(target.id)
+            else:
+                self.names.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self.bind(elt, traced)
+
+    def learn(self, fn: ast.AST) -> None:
+        """Two forward passes over assignments (the second catches names
+        first used above their traced re-binding inside loops)."""
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    traced = self.traced(node.value)
+                    for t in node.targets:
+                        self.bind(t, traced)
+                elif isinstance(node, ast.AugAssign):
+                    if self.traced(node.value):
+                        self.bind(node.target, True)
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    self.bind(node.target, self.traced(node.value))
+
+
+def _call_findings(
+    src: SourceFile, fn: ast.AST, env: _TracedEnv, qual: str
+) -> List[Finding]:
+    out: List[Finding] = []
+
+    def add(node: ast.AST, code: str, msg: str) -> None:
+        out.append(
+            Finding(
+                "zerosync", code, src.relpath, node.lineno,
+                f"{msg} in hot-path function {qual}",
+                context=src.context_line(node.lineno),
+            )
+        )
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func) or ""
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in SYNC_METHODS
+            ):
+                add(node, "CEP-S01", f"host sync .{node.func.attr}()")
+            elif dotted in ("jax.block_until_ready", "jax.device_get"):
+                add(node, "CEP-S01", f"host sync {dotted}()")
+            elif dotted in (
+                "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                "np.copy",
+            ):
+                if node.args and env.traced(node.args[0]):
+                    add(
+                        node, "CEP-S01",
+                        f"{dotted}() materializes a traced value on host",
+                    )
+            elif (
+                isinstance(node.func, ast.Name)
+                and node.func.id in ("float", "int", "bool")
+                and node.args
+                and env.traced(node.args[0])
+            ):
+                add(
+                    node, "CEP-S02",
+                    f"{node.func.id}() scalarizes a traced value "
+                    "(device round-trip)",
+                )
+    return out
+
+
+def _truthiness_findings(
+    src: SourceFile, fn: ast.AST, env: _TracedEnv, qual: str
+) -> List[Finding]:
+    out: List[Finding] = []
+
+    def check_test(expr: ast.AST) -> None:
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                check_test(v)
+            return
+        if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+            check_test(expr.operand)
+            return
+        if env.traced(expr):
+            out.append(
+                Finding(
+                    "zerosync", "CEP-S03", src.relpath, expr.lineno,
+                    "traced-value truthiness forces a device sync "
+                    f"in hot-path function {qual} (use jnp.where/lax.cond)",
+                    context=src.context_line(expr.lineno),
+                )
+            )
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            check_test(node.test)
+        elif isinstance(node, ast.Assert):
+            check_test(node.test)
+    return out
+
+
+def check(files: Sequence[SourceFile], root_dir: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in files:
+        roots, stale = hot_functions(src)
+        for pattern in stale:
+            findings.append(
+                Finding(
+                    "zerosync", "CEP-S04", src.relpath, 0,
+                    f"stale HOT_PATHS pattern {pattern!r} matches no "
+                    "function -- update analysis/zerosync.py",
+                    context=f"hot-paths:{pattern}",
+                )
+            )
+        for qual, fn in roots.items():
+            env = _TracedEnv(fn)
+            env.learn(fn)
+            findings.extend(_call_findings(src, fn, env, qual))
+            findings.extend(_truthiness_findings(src, fn, env, qual))
+    return findings

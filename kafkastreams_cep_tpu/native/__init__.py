@@ -26,16 +26,34 @@ def _build_dir() -> str:
     return os.path.join(os.path.dirname(__file__), "_build")
 
 
-def _so_path(name: str) -> str:
+#: sanitizer build mode: ASan+UBSan with frame pointers for readable
+#: reports. The sanitized object gets its own suffix so a sanitizer pass
+#: never poisons (or races) the plain production build in _build/.
+SANITIZE_FLAGS = (
+    "-fsanitize=address,undefined",
+    "-fno-omit-frame-pointer",
+    "-fno-sanitize-recover=undefined",
+    "-g",
+)
+
+
+def _so_path(name: str, sanitize: bool = False) -> str:
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    return os.path.join(_build_dir(), f"_{name}{suffix}")
+    tag = ".san" if sanitize else ""
+    return os.path.join(_build_dir(), f"_{name}{tag}{suffix}")
 
 
-def build_ext(name: str, force: bool = False) -> Optional[str]:
+def build_ext(
+    name: str, force: bool = False, sanitize: bool = False
+) -> Optional[str]:
     """Compile native/<name>.cc into the package-local _build dir; returns
-    the .so path or None on failure."""
+    the .so path or None on failure.
+
+    `sanitize=True` builds the ASan/UBSan-instrumented variant (slow,
+    for the tests/test_native.py sanitizer pass): loading it requires
+    the toolchain's libasan preloaded -- see `sanitizer_env()`."""
     src = os.path.join(os.path.dirname(__file__), f"{name}.cc")
-    out = _so_path(name)
+    out = _so_path(name, sanitize)
     if not force and os.path.exists(out) and (
         os.path.getmtime(out) >= os.path.getmtime(src)
     ):
@@ -46,6 +64,8 @@ def build_ext(name: str, force: bool = False) -> Optional[str]:
         "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
         f"-I{include}", src, "-o", out,
     ]
+    if sanitize:
+        cmd[2:2] = list(SANITIZE_FLAGS)
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=120
@@ -57,17 +77,65 @@ def build_ext(name: str, force: bool = False) -> Optional[str]:
     return out
 
 
+def _toolchain_lib(name: str) -> Optional[str]:
+    """Absolute path of a g++ runtime library (None when unresolvable)."""
+    try:
+        proc = subprocess.run(
+            ["g++", f"-print-file-name={name}"],
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    path = proc.stdout.strip()
+    if proc.returncode != 0 or not path or not os.path.isabs(path):
+        return None
+    return path if os.path.exists(path) else None
+
+
+def sanitizer_env() -> Optional[dict]:
+    """Environment for a subprocess that loads sanitize=True extensions.
+
+    ASan must own malloc from process start, so the runtime is
+    LD_PRELOADed (dlopen of an ASan .so into a vanilla interpreter fails
+    at __asan_init otherwise). Leak checking is off: the interpreter and
+    jax hold process-lifetime allocations that are not this layer's
+    bugs; ASan still catches overflows/UAF, UBSan aborts on UB
+    (-fno-sanitize-recover). Returns None when the toolchain has no
+    preloadable runtime (the caller should skip, not fail)."""
+    asan = _toolchain_lib("libasan.so")
+    if asan is None:
+        return None
+    env = dict(os.environ)
+    preload = [asan]
+    ubsan = _toolchain_lib("libubsan.so")
+    if ubsan is not None:
+        preload.append(ubsan)
+    prior = env.get("LD_PRELOAD")
+    if prior:
+        preload.append(prior)
+    env["LD_PRELOAD"] = ":".join(preload)
+    env["ASAN_OPTIONS"] = (
+        "detect_leaks=0:abort_on_error=1:allocator_may_return_null=1"
+    )
+    env["UBSAN_OPTIONS"] = "halt_on_error=1:print_stacktrace=1"
+    #: route load_ext onto the sanitized variants in the child.
+    env["KCT_NATIVE_SANITIZE"] = "1"
+    return env
+
+
 def load_ext(name: str) -> Any:
     """The compiled native/_<name> module, or None when unavailable.
 
     Any failure -- no compiler, no headers, sandboxed filesystem -- returns
     None and the caller degrades to its pure-Python path (which stays the
     semantic reference)."""
-    if name in _mods:
-        return _mods[name]
+    sanitize = bool(os.environ.get("KCT_NATIVE_SANITIZE"))
+    cache_key = (name, sanitize)
+    if cache_key in _mods:
+        return _mods[cache_key]
     mod = None
     if not os.environ.get("KCT_NO_NATIVE"):
-        so = build_ext(name)
+        so = build_ext(name, sanitize=sanitize)
         if so is not None:
             try:
                 # The module name must match the PyInit__<name> symbol.
@@ -77,7 +145,7 @@ def load_ext(name: str) -> Any:
                 spec.loader.exec_module(mod)
             except Exception:
                 mod = None
-    _mods[name] = mod
+    _mods[cache_key] = mod
     return mod
 
 

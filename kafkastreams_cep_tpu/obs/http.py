@@ -132,6 +132,9 @@ class IntrospectionServer:
         self.profile_dir = profile_dir
         self._profile_thread: Optional[threading.Thread] = None
         self._profile_lock = threading.Lock()
+        # Request counter lock: routes run on per-request handler threads
+        # (ThreadingHTTPServer), so the += below would lose updates.
+        self._req_lock = threading.Lock()
         self.profile_captures = 0
         self._routes: Dict[str, Callable] = {
             "/metrics": self._route_metrics,
@@ -223,23 +226,27 @@ class IntrospectionServer:
                         "introspection tick failed", exc_info=True
                     )
 
+    def _count_request(self) -> None:
+        with self._req_lock:
+            self.requests += 1
+
     # ---------------------------------------------------------------- routes
     def _route_metrics(self, query: Dict[str, List[str]]):
-        self.requests += 1
+        self._count_request()
         return (
             "text/plain; version=0.0.4; charset=utf-8",
             self.registry.to_prom_text().encode("utf-8"),
         )
 
     def _route_snapshot(self, query: Dict[str, List[str]]):
-        self.requests += 1
+        self._count_request()
         return (
             "application/json",
             json.dumps(self.registry.snapshot()).encode("utf-8"),
         )
 
     def _route_healthz(self, query: Dict[str, List[str]]):
-        self.requests += 1
+        self._count_request()
         from ..faults import injection as _flt
 
         body: Dict[str, Any] = {
@@ -253,7 +260,7 @@ class IntrospectionServer:
         return "application/json", json.dumps(body).encode("utf-8")
 
     def _route_tracez(self, query: Dict[str, List[str]]):
-        self.requests += 1
+        self._count_request()
         limit = _limit(query)
         if query.get("format", [None])[0] == "chrome":
             from .trace_export import chrome_trace
@@ -286,7 +293,7 @@ class IntrospectionServer:
         `device_trace` span (SpanTracer.device), so /tracez shows when a
         profile was taken. One capture at a time: a second request while
         armed replies busy instead of stacking profiler sessions."""
-        self.requests += 1
+        self._count_request()
         try:
             secs = float(query.get("secs", ["1"])[0])
         except (TypeError, ValueError):

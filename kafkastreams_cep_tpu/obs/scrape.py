@@ -203,7 +203,7 @@ class MetricsScraper:
         try:
             parsed = parse_prom_text(self._fetch_text())
         except Exception:
-            self.errors += 1
+            self.errors += 1  # cep: thread-ok(stop() joins the scraper thread before its final main-thread scrape; roots never overlap)
             return False
         for name, by_labels in parsed.items():
             vals = list(by_labels.values())
@@ -216,18 +216,18 @@ class MetricsScraper:
             )
             ring = self.series.get(name)
             if ring is None:
-                ring = self.series[name] = TimeSeries(self.maxlen)
+                ring = self.series[name] = TimeSeries(self.maxlen)  # cep: thread-ok(stop() joins the scraper thread before its final main-thread scrape; roots never overlap)
             ring.append(t, folded)
         if self.sample_rss:
             rss = rss_bytes()
             if rss is not None:
                 ring = self.series.get("process_rss_bytes")
                 if ring is None:
-                    ring = self.series["process_rss_bytes"] = TimeSeries(
+                    ring = self.series["process_rss_bytes"] = TimeSeries(  # cep: thread-ok(stop() joins the scraper thread before its final main-thread scrape; roots never overlap)
                         self.maxlen
                     )
                 ring.append(t, rss)
-        self.scrapes += 1
+        self.scrapes += 1  # cep: thread-ok(stop() joins the scraper thread before its final main-thread scrape; roots never overlap)
         return True
 
     # ------------------------------------------------------------ lifecycle
@@ -248,10 +248,15 @@ class MetricsScraper:
 
     def stop(self, final_scrape: bool = True) -> None:
         self._stop.set()
+        wedged = False
         if self._thread is not None:
             self._thread.join(timeout=5)
+            # A scrape stuck in urlopen can outlive the join timeout;
+            # final-scraping concurrently with it would race the rings
+            # (the thread-ok pragmas in scrape_once rely on this check).
+            wedged = self._thread.is_alive()
             self._thread = None
-        if final_scrape:
+        if final_scrape and not wedged:
             # The run's last state must be in the rings even when the
             # soak ends between ticks (short --quick runs especially).
             self.scrape_once()

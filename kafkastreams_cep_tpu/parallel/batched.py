@@ -889,7 +889,7 @@ class BatchedDeviceNFA:
             else:
                 # Externally packed xs: pull the ledger from the device
                 # (a sync -- correctness over pipelining on this rare path).
-                entry = (np.asarray(xs["gidx"]), np.asarray(xs["valid"]))
+                entry = (np.asarray(xs["gidx"]), np.asarray(xs["valid"]))  # cep: sync-ok(externally packed xs on the exact-replay path: correctness over pipelining, comment above)
             if len(self._interval_packs) >= self.REPLAY_LEDGER_MAX_BATCHES:
                 if not self._interval_overflow:
                     import warnings
@@ -1005,7 +1005,7 @@ class BatchedDeviceNFA:
             )
             self.state, ys = self._advance(self.state, xs)
         if sync_profile:
-            jax.block_until_ready(ys)
+            jax.block_until_ready(ys)  # cep: sync-ok(sampled phase profiling: profile_sync/profile_every deliberately trade async for compute walls)
         t_adv = _time.perf_counter()
         # Per-advance light post: pend append (capacity guards keep
         # observing true counts) + group-phase bump; the node window and
@@ -1022,7 +1022,7 @@ class BatchedDeviceNFA:
         # construction): no pull needed.
         self._m_gc_phase.set(len(self._group_ys))
         if sync_profile:
-            jax.block_until_ready((self.state, self.pool))
+            jax.block_until_ready((self.state, self.pool))  # cep: sync-ok(sampled phase profiling: profile_sync/profile_every deliberately trade async for compute walls)
             # Both blocks landed: these are COMPUTE walls, not dispatch
             # walls -- the kernel-time drift signal. Skipped when this
             # advance compiled anything (see seen_before above; without a

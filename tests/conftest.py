@@ -70,3 +70,46 @@ def pytest_configure(config):
         "soak: production-soak suite (CI-sized --quick runs, CPU-safe)",
     )
     config.addinivalue_line("markers", "slow: excluded from tier-1")
+    # `lint` selects the static-analysis gate (tests/test_lint.py):
+    # ceplint over the full package, mutation fixtures, pragma/baseline
+    # semantics, the jit-cache audit, and the lock-order monitor.
+    config.addinivalue_line(
+        "markers",
+        "lint: static-analysis invariant gate (ceplint; fast, CPU-safe)",
+    )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_monitor(request):
+    """Arm the instrumented-Lock monitor (analysis/lockmon.py) for the
+    chaos and soak suites -- the runs that exercise the obs serve/clock,
+    scraper, driver, and decode threads together (ISSUE 13). Any
+    lock-order cycle observed during the test is a potential deadlock
+    and fails it, with the held->acquired graph in the report."""
+    if (
+        request.node.get_closest_marker("chaos") is None
+        and request.node.get_closest_marker("soak") is None
+    ):
+        yield
+        return
+    from kafkastreams_cep_tpu.analysis.lockmon import (
+        LockMonitor,
+        active_monitor,
+    )
+
+    if active_monitor() is not None:  # nested arming (subprocess runs)
+        yield
+        return
+    mon = LockMonitor().install()
+    try:
+        yield
+    finally:
+        mon.uninstall()
+    cycles = mon.cycles()
+    assert not cycles, (
+        "lock-order cycle(s) observed (potential deadlock):\n"
+        + mon.report()
+    )

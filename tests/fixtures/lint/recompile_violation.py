@@ -1,0 +1,47 @@
+"""Seeded recompile hazards: every class the checker must flag.
+
+Mutation fixture for tests/test_lint.py. NOT runnable production code.
+"""
+import jax
+import jax.numpy as jnp
+
+TABLES = [1, 2, 3]  # module-level mutable
+
+
+def churn(fns):
+    out = []
+    for f in fns:
+        out.append(jax.jit(f))      # CEP-R01: jit in a loop
+
+
+# cep: hot-path
+def hot_step(state, xs):
+    fn = jax.jit(lambda s: s + 1)   # CEP-R02: fresh cache per call
+    return fn(state)
+
+
+def build_static_hazard():
+    def step(state, config={}):     # mutable default on the static arg
+        return state
+
+    return jax.jit(step, static_argnames=("config",))  # CEP-R03
+
+
+class Engine:
+    def build_adv(self):
+        @jax.jit
+        def adv(state):
+            return state + self.offset + jnp.sum(TABLES[0])  # CEP-R04 x2
+
+        return adv
+
+
+def build_rebound():
+    scale = 2
+
+    def inner(state):
+        return state * scale
+
+    fn = jax.jit(inner)
+    scale = 3                        # CEP-R05: rebound after the wrap
+    return fn

@@ -1,0 +1,23 @@
+"""Structures for the seeded serde-completeness violations.
+
+Paired with serde_violation.py via monkeypatched bindings in
+tests/test_lint.py. NOT runnable production code.
+"""
+from dataclasses import dataclass
+from typing import Any, Dict
+
+
+@dataclass
+class Record:
+    a: int
+    b: int
+    c: int  # encode/decode in serde_violation.py both drop this field
+    skipme: int = 0  # cep: serde-ok(derived at load time; fixture pragma)
+
+
+class Gate:
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {"x": 1, "y": 2, "z": 3}  # 'z' is never encoded
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        self.x = state["x"]  # 'y' decoded but never consumed

@@ -1,0 +1,30 @@
+"""Seeded serde-completeness violations (the PR 9 bug class).
+
+Encode/decode pairs that drop fields; bound to serde_structs.py by
+tests/test_lint.py via monkeypatched STRUCT_BINDINGS/DICT_BINDINGS.
+NOT runnable production code.
+"""
+from typing import Any, Dict
+
+from .serde_structs import Record
+
+
+def encode_record(w, rec: Record) -> None:
+    w.i64(rec.a)
+    w.i64(rec.b)  # rec.c never written: CEP-D01
+
+
+def decode_record(r) -> Record:
+    return Record(a=r.i64(), b=r.i64(), c=0, skipme=0)  # c supplied; fine
+
+
+def encode_gate_state(state: Dict[str, Any]) -> bytes:
+    # reads x and y; 'z' from snapshot_state is dropped: CEP-D01
+    return b"%d,%d" % (state["x"], state["y"])
+
+
+def decode_gate_state(data: bytes) -> Dict[str, Any]:
+    x, y = data.split(b",")
+    out: Dict[str, Any] = {"x": int(x), "y": int(y)}
+    out["q"] = 0  # never encoded: CEP-D03; 'y' never consumed: CEP-D03
+    return out

@@ -1,0 +1,34 @@
+"""Seeded thread-shared-state violations.
+
+Mutation fixture for tests/test_lint.py: an attribute written from two
+thread roots with no lock (CEP-T01), and an anonymous thread root
+(CEP-T03). NOT runnable production code.
+"""
+import threading
+
+
+class LeakyWorker:
+    def __init__(self) -> None:
+        self.counter = 0
+        self.ok = 0
+        self._lock = threading.Lock()
+        self._thread = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="fixture-loop", daemon=True
+        )
+        self._thread.start()
+        # CEP-T03: anonymous root.
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self) -> None:
+        while True:
+            self.counter += 1        # CEP-T01: also written from main
+            with self._lock:
+                self.ok += 1         # guarded everywhere: clean
+
+    def bump(self) -> None:
+        self.counter += 1            # CEP-T01: main-root write, no lock
+        with self._lock:
+            self.ok += 1

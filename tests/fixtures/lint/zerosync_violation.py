@@ -1,0 +1,27 @@
+"""Seeded zero-sync violations: every construct the checker must flag.
+
+Mutation fixture for tests/test_lint.py -- ceplint must exit 1 on this
+file (the gate is proven able to fail). NOT runnable production code.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+# cep: hot-path
+def hot_advance(state, xs):
+    occupancy = jnp.max(state["pend_pos"])          # traced
+    n = int(occupancy)                              # CEP-S02 scalarization
+    host = np.asarray(xs["gidx"])                   # CEP-S01 materialize
+    jax.block_until_ready(occupancy)                # CEP-S01 hard sync
+    state["runs"].item()                            # CEP-S01 .item()
+    if occupancy > 0:                               # CEP-S03 truthiness
+        n += 1
+    flag = bool(xs["valid"])                        # CEP-S02 bool()
+    return n, host, flag
+
+
+def cold_helper(state):
+    """Not hot-path marked: the same constructs are fine here."""
+    return int(jnp.max(state["pend_pos"]))
