@@ -1011,6 +1011,91 @@ def bench_introspection() -> Dict[str, Any]:
     )
 
 
+def bench_transport_loopback() -> Dict[str, Any]:
+    """Smoke-only wire-transport pass (ISSUE 15, streams/transport.py):
+    the SAME durable letters pipeline run twice -- once over an in-memory
+    RecordLog (the golden), once over a loopback RecordLogServer with a
+    windowed SocketRecordLog client (window=32: appends pipeline against
+    predicted offsets; a full window blocks, which IS the propagated
+    backpressure) -- and the sink digests must be byte-equal. The wire
+    figures (frames, bytes, reconnects, retries, torn frames,
+    backpressure hits) come from a private registry so the loopback's
+    counters stay out of the flagship exposition.
+
+    This is a CODE-PATH pass, not a throughput claim: loopback TCP on a
+    CI box measures the framing/ack overhead, which is the number worth
+    tracking round-over-round."""
+    from kafkastreams_cep_tpu import (
+        ComplexStreamsBuilder,
+        LogDriver,
+        RecordLog,
+        produce,
+    )
+    from kafkastreams_cep_tpu.obs import MetricsRegistry
+    from kafkastreams_cep_tpu.streams.emission import decode_sink_key
+    from kafkastreams_cep_tpu.streams.transport import (
+        RecordLogServer,
+        SocketRecordLog,
+    )
+
+    rng = random.Random(7)
+    stream = letters_stream(rng, 512)
+    window = 32
+
+    def _run(log):
+        builder = ComplexStreamsBuilder(log=log, app_id="bench-wire")
+        builder.stream("letters").query(
+            "q-wire", letters_pattern(), runtime="host", registry=reg
+        ).to("matches")
+        driver = LogDriver(
+            builder.build(), group="bench-wire", registry=reg,
+            reporter=lambda text: None,
+        )
+        t0 = time.perf_counter()
+        for e in stream:
+            produce(log, "letters", e.key, e.value, timestamp=e.timestamp)
+        produce_dt = time.perf_counter() - t0
+        driver.poll()
+        e2e_dt = time.perf_counter() - t0
+        sinks = sorted(
+            (decode_sink_key(r.key)[1], r.value)
+            for r in log.read("matches")
+        )
+        return sinks, produce_dt, e2e_dt
+
+    reg = MetricsRegistry()
+    golden, _, _ = _run(RecordLog())
+
+    server = RecordLogServer(RecordLog(), registry=reg).start()
+    client = SocketRecordLog(server.address, registry=reg, window=window)
+    try:
+        wire, produce_dt, e2e_dt = _run(client)
+    finally:
+        client.close()
+        server.stop()
+        server.backing.close()
+
+    def _total(family: str) -> float:
+        fam = reg.snapshot().get(family) or {}
+        return sum(float(v.get("value", 0.0)) for v in fam.get("values", ()))
+
+    wire_bytes = _total("cep_transport_bytes_total")
+    return dict(
+        events=len(stream),
+        matches=len(wire),
+        digest_equal=sorted(golden) == sorted(wire),
+        window=window,
+        produce_eps=len(stream) / produce_dt if produce_dt else None,
+        e2e_eps=len(stream) / e2e_dt if e2e_dt else None,
+        frames=_total("cep_transport_frames_total"),
+        wire_mb=wire_bytes / 1e6,
+        backpressure_hits=_total("cep_transport_backpressure_total"),
+        reconnects=_total("cep_transport_reconnects_total"),
+        retries=_total("cep_transport_retries_total"),
+        torn_frames=_total("cep_transport_torn_frames_total"),
+    )
+
+
 def _compile_block(flagship_metrics: Dict[str, Any]) -> Dict[str, Any]:
     """The artifact's `compile` block (ISSUE 9): per-entry-point compile
     telemetry from the flagship engine's registry snapshot -- compile
@@ -1292,6 +1377,18 @@ def main() -> None:
                 f"latency_count "
                 f"{(intro['match_latency'] or {}).get('count')}"
             )
+            # Wire-transport loopback pass (ISSUE 15): the durable
+            # pipeline over a real socket, digest-pinned vs an in-memory
+            # golden; sources the artifact's top-level `transport` block.
+            log("transport loopback (socket RecordLog, windowed appends)")
+            tl = bench_transport_loopback()
+            detail["transport_pass"] = tl
+            log(
+                f"transport: digest_equal={tl['digest_equal']} "
+                f"e2e {tl['e2e_eps']:.0f} ev/s, {tl['frames']:.0f} frames "
+                f"/ {tl['wire_mb']:.2f} MB, "
+                f"backpressure {tl['backpressure_hits']:.0f}"
+            )
         # Config 4: N concurrent queries over one stream.
         log("multi_query (config 4)")
         detail["multi_query"] = bench_multi_query(
@@ -1401,6 +1498,10 @@ def main() -> None:
         # in-order baseline + watermark lag percentiles; None when the
         # skip_any8 family did not run.
         "watermark": detail.pop("watermark_pass", None),
+        # Wire-transport loopback pass (ISSUE 15): exactly-once digest
+        # equality + framing overhead over a socket RecordLog; None
+        # outside --smoke (the full bench drives engines directly).
+        "transport": detail.pop("transport_pass", None),
         "platform": platform,
         "quick": quick,
         # No JVM is provisionable in this zero-egress image: the baseline

@@ -27,6 +27,7 @@ from .streams.builder import ComplexStreamsBuilder
 from .streams.driver import LogDriver, produce
 from .streams.log import RecordLog
 from .streams.processor import CEPProcessor
+from .streams.transport import RecordLogServer, SocketRecordLog, TransportError
 from .streams.serde import Queried, sequence_to_json
 from .obs import MetricsRegistry, SpanTracer, default_registry
 from .time import (
@@ -100,6 +101,9 @@ __all__ = [
     "LogDriver",
     "QueryStoreBuilders",
     "RecordLog",
+    "RecordLogServer",
+    "SocketRecordLog",
+    "TransportError",
     "produce",
     "Queried",
     "sequence_to_json",

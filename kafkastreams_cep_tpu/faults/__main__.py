@@ -10,6 +10,13 @@ Two modes share this entry point:
     # self-scraped metrics time series + SLO verdict artifact
     python -m kafkastreams_cep_tpu.faults soak --quick --out SOAK.json
 
+    # WIRE TRANSPORT (ISSUE 15, streams/transport.py) -- terminal A
+    # serves a RecordLog over a socket, terminal B runs a seeded chaos
+    # pipeline against it (partial writes + disconnects injected client-
+    # side) and pins digest equality vs a local fault-free golden run:
+    python -m kafkastreams_cep_tpu.faults --listen 9092 --listen-dir /tmp/wal
+    python -m kafkastreams_cep_tpu.faults --connect 127.0.0.1:9092
+
 For each sweep seed it builds a fresh durable pipeline (letters query over
 a file-backed RecordLog in a temp dir), computes the fault-free golden sink
 stream, then replays the same stream under a seeded `FaultSchedule`,
@@ -54,7 +61,30 @@ def main(argv=None) -> int:
         "/healthz /tracez) over the process-default registry while the "
         "soak runs; 0 binds an ephemeral port (printed)",
     )
+    ap.add_argument(
+        "--listen", default=None, metavar="[HOST:]PORT",
+        help="serve a RecordLog over the wire (streams/transport.py) "
+        "until Ctrl-C (or --listen-for), instead of sweeping",
+    )
+    ap.add_argument(
+        "--listen-dir", default=None, metavar="DIR",
+        help="file-backed segment dir for --listen (default: in-memory)",
+    )
+    ap.add_argument(
+        "--listen-for", type=float, default=None, metavar="SECONDS",
+        help="stop the --listen server after this many seconds",
+    )
+    ap.add_argument(
+        "--connect", default=None, metavar="HOST:PORT",
+        help="run one seeded chaos pipeline (seed = --seeds-from) over a "
+        "remote --listen RecordLogServer: net.partial_write and "
+        "net.disconnect join the schedule, and the sink digests must "
+        "equal a local fault-free golden run (needs a FRESH server log)",
+    )
     args = ap.parse_args(argv)
+
+    if args.listen is not None:
+        return _serve(args)
 
     import jax
 
@@ -76,6 +106,9 @@ def main(argv=None) -> int:
     )
 
     from . import FaultSchedule
+
+    if args.connect is not None:
+        return _connect_run(args, FaultSchedule)
 
     sites = DRIVER_SITES + (
         ("engine.mid_drain",) if args.runtime == "tpu" else ()
@@ -145,6 +178,95 @@ def main(argv=None) -> int:
     if server is not None:
         server.stop()
     return 1 if failures else 0
+
+
+def _parse_addr(spec: str, default_host: str = "127.0.0.1"):
+    host, _, port_s = spec.rpartition(":")
+    return (host or default_host, int(port_s))
+
+
+def _serve(args) -> int:
+    """--listen: broker a RecordLog over the wire for remote --connect
+    runs (or any SocketRecordLog). No jax import -- this is a pure
+    host-side broker process."""
+    import time
+
+    from ..streams.log import RecordLog
+    from ..streams.transport import RecordLogServer
+
+    host, port = _parse_addr(args.listen)
+    server = RecordLogServer(
+        RecordLog(args.listen_dir), host=host, port=port
+    ).start()
+    addr = server.address
+    where = args.listen_dir or "in-memory"
+    print(f"RecordLogServer on {addr[0]}:{addr[1]} (backing: {where}); "
+          "Ctrl-C to stop")
+    try:
+        if args.listen_for is not None:
+            time.sleep(args.listen_for)
+        else:
+            while True:
+                time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    server.backing.close()
+    return 0
+
+
+def _connect_run(args, fault_schedule_cls) -> int:
+    """--connect: the sweep harness once (seed = --seeds-from), with the
+    durable log on the far side of a socket and the wire fault sites in
+    the schedule. Digest equality vs the local fault-free golden run is
+    the same exactly-once pin the CI suite enforces."""
+    import pathlib
+    import tempfile
+
+    from test_faults import DEVICE_OPTS, _chaos, _golden, _stream
+
+    from ..streams.transport import SocketRecordLog
+
+    host, port = _parse_addr(args.connect)
+    probe = SocketRecordLog((host, port))
+    dirty = probe.end_offset("letters") or probe.end_offset("matches")
+    probe.close()
+    if dirty:
+        print(f"--connect: the server log at {host}:{port} already has "
+              "letters/matches records; exactly-once digests need a "
+              "fresh --listen server", file=sys.stderr)
+        return 2
+    opts = dict(DEVICE_OPTS) if args.runtime == "tpu" else {}
+    keys = ("k0", "k1") if args.runtime == "tpu" else ("K",)
+    seed = args.seeds_from
+    stream = _stream(seed, n=args.events)
+    golden = _golden(stream, keys=keys, runtime=args.runtime, **opts)
+    # log.torn_append lives in the REMOTE process (it is not armed
+    # there), so the wire sweep schedules driver crashes + client-side
+    # wire damage only.
+    sites = ("driver.pre_commit", "driver.post_commit",
+             "net.partial_write", "net.disconnect")
+    schedule = fault_schedule_cls.seeded(
+        seed, sites=sites, n_points=args.points
+    )
+
+    class _Tmp:
+        def __truediv__(self, name):
+            return pathlib.Path(tempfile.mkdtemp()) / name
+
+    chaos, crashes = _chaos(
+        _Tmp(), schedule, stream, keys=keys, runtime=args.runtime,
+        log_open=lambda: SocketRecordLog(
+            (host, port), backoff_seed=seed, io_timeout_s=2.0,
+        ),
+        **opts,
+    )
+    ok = sorted(chaos) == sorted(golden)
+    print(f"connect {host}:{port} seed {seed}: {len(golden)} matches, "
+          f"{crashes} crashes, {'OK' if ok else 'DIVERGED'}")
+    if not ok:
+        print(f"  schedule: {schedule}")
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -29,6 +29,15 @@ Named sites (the full set is `ALL_SITES`):
                           gate catches it and treats the reorder buffer
                           as full NOW, so chaos schedules exercise the
                           overflow policy path without filling a buffer)
+  net.partial_write       SocketRecordLog frame send: half the frame lands
+                          on the socket, then the connection is damaged
+                          (transient -- the peer discards the torn frame
+                          and the client's reconnect path re-delivers)
+  net.disconnect          SocketRecordLog send/recv between frames
+                          (transient -- reconnect + idempotent replay)
+  net.stall               RecordLogServer apply loop: the server freezes
+                          past the client's IO deadline (transient -- the
+                          client's heartbeat/stall detection reconnects)
 
 Crashes raise `InjectedCrash`, a BaseException subclass so no quarantine /
 best-effort `except Exception` in the pipeline can accidentally swallow a
@@ -74,6 +83,11 @@ TRANSIENT_SITES: Tuple[str, ...] = (
     "engine.device_step",
     "driver.restore",
     "time.reorder_overflow",
+    # Wire-transport sites (streams/transport.py): connection damage is
+    # recoverable by design -- reconnect/backoff + idempotent replay.
+    "net.partial_write",
+    "net.disconnect",
+    "net.stall",
 )
 ALL_SITES: Tuple[str, ...] = CRASH_SITES + TRANSIENT_SITES
 
@@ -217,6 +231,8 @@ class FaultInjector:
                 self._tear(ctx)
             if site == "store.checkpoint_write":
                 self._corrupt_checkpoint(ctx)
+            if site == "net.partial_write":
+                self._partial_send(ctx)
             if site in TRANSIENT_SITES:
                 raise TransientFault(site)
             raise InjectedCrash(site)
@@ -232,6 +248,20 @@ class FaultInjector:
             f.write(payload[: max(1, len(payload) // 2)])
             f.flush()
             os.fsync(f.fileno())
+
+    @staticmethod
+    def _partial_send(ctx: dict) -> None:
+        """Land the first half of the wire frame on the socket, then
+        sever: the peer reads a torn frame (mid-frame EOF or CRC reject),
+        discards it without applying, and drops the connection -- the
+        client's reconnect path owns re-delivery on a clean frame
+        boundary (streams/transport.py)."""
+        sock, payload = ctx.get("sock"), ctx.get("payload", b"")
+        if sock is not None and payload:
+            try:
+                sock.sendall(payload[: max(1, len(payload) // 2)])
+            except OSError:
+                pass  # an already-dead socket IS the disconnect
 
     @staticmethod
     def _corrupt_checkpoint(ctx: dict) -> None:

@@ -56,6 +56,10 @@ CLI (also `python -m kafkastreams_cep_tpu.faults soak ...`):
 
     # seeded violation (forced reorder-overflow drops) -- must exit 1:
     python -m kafkastreams_cep_tpu.faults soak --quick --violation drops
+
+    # every durable byte over a loopback socket broker, chaos schedule
+    # extended with the net.* wire faults (ISSUE 15):
+    python -m kafkastreams_cep_tpu.faults soak --quick --transport socket
 """
 from __future__ import annotations
 
@@ -268,6 +272,8 @@ class SoakRun:
         self.processed = 0
         self.driver = None
         self.log = None
+        self._server = None  # RecordLogServer under --transport socket
+        self._registry = None
         self._live_churn: Tuple[str, ...] = ()
 
     # ----------------------------------------------------------- topology
@@ -308,15 +314,34 @@ class SoakRun:
             self._build_topology(registry), group="soak", registry=registry,
         )
 
-    def _crash_recover(self, registry) -> None:
+    def _open_log(self):
+        """The durable log handle pipelines use: the file-backed log, or
+        (--transport socket) a fresh wire client onto the loopback
+        broker. A crash drops the client (its session dies with it); the
+        broker and its idempotent-producer state survive, as a real
+        broker would survive an application restart."""
+        if self._server is not None:
+            from ..streams.transport import SocketRecordLog
+
+            return SocketRecordLog(
+                self._server.address,
+                registry=self._registry,
+                window=8,
+                io_timeout_s=2.0,
+                heartbeat_s=2.0,
+                backoff_seed=self.args.seed,
+            )
         from ..streams.log import RecordLog
 
+        return RecordLog(self._log_path)
+
+    def _crash_recover(self, registry) -> None:
         self.crashes += 1
         try:
             self.log.close()
         except Exception:
             pass
-        self.log = RecordLog(self._log_path)
+        self.log = self._open_log()
         self._rebuild(registry)
 
     # ---------------------------------------------------------------- run
@@ -349,7 +374,19 @@ class SoakRun:
             )
         workdir = args.dir or tempfile.mkdtemp(prefix="cep-soak-")
         self._log_path = os.path.join(workdir, "wal")
-        self.log = RecordLog(self._log_path)
+        self._registry = registry
+        if args.transport == "socket":
+            # The loopback broker: every durable byte of the run crosses
+            # a real socket. stall_inject_s ABOVE the client IO deadline
+            # so injected net.stall points force stall-detection
+            # reconnects rather than being absorbed as latency.
+            from ..streams.transport import RecordLogServer
+
+            self._server = RecordLogServer(
+                RecordLog(self._log_path), registry=registry,
+                stall_inject_s=3.0,
+            ).start()
+        self.log = self._open_log()
 
         churn = QueryChurnPlan(args.seed, period_s=args.churn_period)
         self._live_churn = churn.live(0)
@@ -358,6 +395,10 @@ class SoakRun:
             "driver.pre_commit", "driver.post_commit", "log.torn_append",
             "time.reorder_overflow",
         ]
+        if args.transport == "socket":
+            sites.extend(
+                ["net.partial_write", "net.disconnect", "net.stall"]
+            )
         if any(sc.runtime == "tpu" for sc in self.fleet):
             sites.append("engine.mid_drain")
         points: List[FaultPoint] = []
@@ -488,7 +529,17 @@ class SoakRun:
             except Exception:
                 pass
 
-        return self._verdict(registry, scraper, wall, jax)
+        try:
+            return self._verdict(registry, scraper, wall, jax)
+        finally:
+            # The verdict reads sink matches through the live transport;
+            # only then may the client and the loopback broker go down.
+            if self._server is not None:
+                try:
+                    self.log.close()
+                except Exception:
+                    pass
+                self._server.stop()
 
     # ------------------------------------------------------------- verdict
     def _drop_totals(self, registry) -> Tuple[Dict[str, float], float, float]:
@@ -669,6 +720,7 @@ class SoakRun:
                 "quick": bool(args.quick),
                 "platform": platform,
                 "runtime": args.runtime,
+                "transport": args.transport,
                 "violation": args.violation,
                 "duration_s": args.duration,
                 "wall_s": wall,
@@ -787,6 +839,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--scenarios", default=None,
                     help="comma-separated fleet subset "
                     "(hotspot,match_storm,watermark_stall)")
+    ap.add_argument("--transport", default="file",
+                    choices=["file", "socket"],
+                    help="durable-log transport: 'file' (embedded "
+                    "RecordLog) or 'socket' (a loopback RecordLogServer "
+                    "brokers the same file-backed log; every append/read "
+                    "crosses the wire and the chaos schedule gains the "
+                    "net.* fault sites)")
     ap.add_argument("--chunk", type=int, default=None,
                     help="events per scenario per pump iteration")
     ap.add_argument("--chaos-points", type=int, default=None,

@@ -61,6 +61,16 @@ FAULT_SERIES: Tuple[str, ...] = (
     # late_policy=drop, or reorder-buffer overflow under on_overflow=drop.
     "cep_late_dropped_total",
     "cep_reorder_overflow_dropped_total",
+    # Wire-transport fault families (ISSUE 15, streams/transport.py):
+    # evidence of connection damage and its recovery -- all zero on a
+    # healthy loopback run, nonzero exactly when chaos (or a real
+    # network) bit and the reconnect/replay machinery engaged.
+    "cep_transport_retries_total",
+    "cep_transport_disconnects_total",
+    "cep_transport_stalls_total",
+    "cep_transport_torn_frames_total",
+    "cep_transport_dedup_total",
+    "cep_transport_server_restarts_total",
 )
 
 

@@ -2,4 +2,5 @@ from .builder import CEPStream, ComplexStreamsBuilder, OutputStream, Record, Top
 from .driver import LogDriver, produce
 from .log import LogRecord, RecordLog
 from .processor import CEPProcessor
+from .transport import RecordLogServer, SocketRecordLog, TransportError
 from .serde import Queried, sequence_to_dict, sequence_to_json

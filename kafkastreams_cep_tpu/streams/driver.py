@@ -470,6 +470,14 @@ class LogDriver:
             # soak (and operators) gate on event-time health from the
             # same JSON the liveness probes already read.
             "event_time": self.topology.event_time_health(),
+            # The wire-transport plane (ISSUE 15): when the log is a
+            # SocketRecordLog its connection/heartbeat health rides the
+            # same /healthz body; None for the embedded file/memory log.
+            "transport": (
+                self.log.health()
+                if callable(getattr(self.log, "health", None))
+                else None
+            ),
             "faults_armed": _flt.ACTIVE is not None,
             "report_every_s": self.report_every_s,
         }

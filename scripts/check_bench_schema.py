@@ -57,6 +57,7 @@ TOP_LEVEL: Dict[str, Tuple[bool, tuple]] = {
     "observation": (True, (dict,)),
     "metrics_merged": (True, (dict, type(None))),
     "watermark": (True, (dict, type(None))),
+    "transport": (True, (dict, type(None))),
     "compile": (True, (dict,)),
     "regression": (True, (dict, type(None))),
     "schema_ok": (False, (bool,)),
@@ -80,6 +81,25 @@ WATERMARK_KEYS: Dict[str, tuple] = {
     "n_expired_reorder": (int, float),
     "keys": (int, float),
     "batch": (int, float),
+}
+
+#: The `transport` block (ISSUE 15): the smoke's wire-transport loopback
+#: pass -- the durable pipeline over a socket RecordLog digest-pinned vs
+#: an in-memory golden, plus the framing overhead figures; None outside
+#: --smoke.
+TRANSPORT_KEYS: Dict[str, tuple] = {
+    "events": NUMBER,
+    "matches": NUMBER,
+    "digest_equal": (bool,),
+    "window": NUMBER,
+    "produce_eps": OPT_NUMBER,
+    "e2e_eps": OPT_NUMBER,
+    "frames": NUMBER,
+    "wire_mb": NUMBER,
+    "backpressure_hits": NUMBER,
+    "reconnects": NUMBER,
+    "retries": NUMBER,
+    "torn_frames": NUMBER,
 }
 
 #: The `observation` block (ISSUE 7): what telemetry was armed while the
@@ -163,6 +183,15 @@ FAULT_KEYS = (
     "cep_emit_deduped_total",
     "cep_late_dropped_total",
     "cep_reorder_overflow_dropped_total",
+    # Wire-transport families (ISSUE 15, streams/transport.py): nonzero
+    # retries/disconnects/stalls/torn-frames/dedup/restarts in a bench or
+    # soak artifact mean the wire itself took (or injected) damage.
+    "cep_transport_retries_total",
+    "cep_transport_disconnects_total",
+    "cep_transport_stalls_total",
+    "cep_transport_torn_frames_total",
+    "cep_transport_dedup_total",
+    "cep_transport_server_restarts_total",
 )
 
 #: The per-component breakdown (ops/profiling.py BatchTimings.components):
@@ -199,6 +228,7 @@ SOAK_RUN_KEYS: Dict[str, tuple] = {
     "quick": (bool,),
     "platform": (str,),
     "runtime": (str,),
+    "transport": (str,),
     "violation": (str,),
     "duration_s": NUMBER,
     "wall_s": NUMBER,
@@ -507,6 +537,10 @@ def validate(out: Any) -> List[str]:
     if isinstance(out.get("watermark"), (dict, type(None))):
         _check_flat_block(
             out.get("watermark"), WATERMARK_KEYS, "watermark", errors
+        )
+    if isinstance(out.get("transport"), (dict, type(None))):
+        _check_flat_block(
+            out.get("transport"), TRANSPORT_KEYS, "transport", errors
         )
     compile_block = out.get("compile")
     if isinstance(compile_block, dict):

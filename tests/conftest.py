@@ -69,6 +69,14 @@ def pytest_configure(config):
         "markers",
         "soak: production-soak suite (CI-sized --quick runs, CPU-safe)",
     )
+    # `transport` mirrors the other suite markers: rides tier-1 at
+    # --quick size, and `pytest -m transport` selects the wire-transport
+    # suite (framed socket RecordLog, reconnect/backoff, exactly-once
+    # over loopback; the long loopback soak is additionally `slow`).
+    config.addinivalue_line(
+        "markers",
+        "transport: wire-transport suite (loopback sockets, CPU-safe)",
+    )
     config.addinivalue_line("markers", "slow: excluded from tier-1")
     # `lint` selects the static-analysis gate (tests/test_lint.py):
     # ceplint over the full package, mutation fixtures, pragma/baseline
@@ -85,13 +93,15 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def _lock_order_monitor(request):
     """Arm the instrumented-Lock monitor (analysis/lockmon.py) for the
-    chaos and soak suites -- the runs that exercise the obs serve/clock,
-    scraper, driver, and decode threads together (ISSUE 13). Any
-    lock-order cycle observed during the test is a potential deadlock
-    and fails it, with the held->acquired graph in the report."""
+    chaos, soak and transport suites -- the runs that exercise the obs
+    serve/clock, scraper, driver, decode and transport threads together
+    (ISSUE 13). Any lock-order cycle observed during the test is a
+    potential deadlock and fails it, with the held->acquired graph in
+    the report."""
     if (
         request.node.get_closest_marker("chaos") is None
         and request.node.get_closest_marker("soak") is None
+        and request.node.get_closest_marker("transport") is None
     ):
         yield
         return

@@ -122,12 +122,17 @@ def _golden(stream, keys=("K",), runtime="host", **device_opts):
 
 
 def _chaos(tmp_path, schedule, stream, keys=("K",), runtime="host",
-           max_crashes=24, **device_opts):
+           max_crashes=24, log_open=None, **device_opts):
     """Drive the same stream against a durable log with `schedule` armed,
     rebuilding from disk after every simulated crash; returns the final
-    sink content and the number of crashes survived."""
+    sink content and the number of crashes survived. `log_open` swaps the
+    durable-log factory (ISSUE 15: a SocketRecordLog onto a loopback
+    broker) -- a "crash" then drops the client while the broker-side
+    bytes survive, exactly as the file path drops objects but keeps
+    segments."""
     path = str(tmp_path / "wal")
-    log = RecordLog(path)
+    open_log = log_open or (lambda: RecordLog(path))
+    log = open_log()
     for i, ch in enumerate(stream):
         produce(log, "letters", keys[(i // 6) % len(keys)], ch, timestamp=i)
     log.flush()
@@ -146,7 +151,7 @@ def _chaos(tmp_path, schedule, stream, keys=("K",), runtime="host",
                 assert crashes <= max_crashes, "chaos harness did not converge"
                 # Process death: durable bytes survive, objects do not.
                 log.close()
-                log = RecordLog(path)
+                log = open_log()
     digests = _sink_digests(log)
     log.close()
     return digests, crashes

@@ -512,6 +512,9 @@ def _valid_artifact():
             "keys": 8,
             "batch": 16,
         },
+        # ISSUE 15: the wire-transport loopback pass (None outside
+        # --smoke).
+        "transport": None,
         # ISSUE 9: compile telemetry + regression verdict blocks.
         "compile": {
             "fns": {
@@ -613,6 +616,25 @@ def test_bench_schema_validates_compile_and_regression_blocks():
     errors = validate_bench_schema(art3)
     assert any("excused" in e for e in errors)
     assert any("extra" in e for e in errors)
+
+
+def test_bench_schema_validates_transport_block():
+    # transport: None is the documented non-smoke shape...
+    assert validate_bench_schema(_valid_artifact()) == []
+    # ...but a populated loopback pass must carry every documented key.
+    art = _valid_artifact()
+    art["transport"] = {
+        "events": 512, "matches": 10, "digest_equal": True, "window": 32,
+        "produce_eps": 800.0, "e2e_eps": 450.0, "frames": 6270.0,
+        "wire_mb": 0.65, "backpressure_hits": 1453.0, "reconnects": 0,
+        "retries": 0, "torn_frames": 0,
+    }
+    assert validate_bench_schema(art) == []
+    del art["transport"]["digest_equal"]
+    art["transport"]["surprise"] = 1
+    errors = validate_bench_schema(art)
+    assert any("digest_equal" in e for e in errors)
+    assert any("surprise" in e for e in errors)
 
 
 def test_bench_schema_catches_metrics_roundtrip_corruption():
