@@ -1131,6 +1131,17 @@ def _compile_block(flagship_metrics: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def _bench_mode() -> str:
+    """This run's bench mode, self-described in the artifact so the perf
+    ledger can excuse cross-mode deltas (full vs CI-sized workloads)
+    without guessing from legacy markers."""
+    if ARGS.smoke:
+        return "smoke"
+    if ARGS.quick:
+        return "quick"
+    return "full"
+
+
 def _regression_block(
     detail: Dict[str, Any], tunnel_degraded: bool, platform: str
 ):
@@ -1148,6 +1159,7 @@ def _regression_block(
         "configs": detail,
         "tunnel_degraded": tunnel_degraded,
         "platform": platform,
+        "mode": _bench_mode(),
     }
     block = compare_artifacts(
         prior, cur, tolerance=ARGS.tolerance, prior_name=ARGS.compare
@@ -1504,6 +1516,10 @@ def main() -> None:
         "transport": detail.pop("transport_pass", None),
         "platform": platform,
         "quick": quick,
+        # Explicit bench mode (full | quick | smoke): the perf ledger's
+        # mode_change excusal reads this instead of inferring from the
+        # quick/schema_ok markers legacy artifacts carry.
+        "mode": _bench_mode(),
         # No JVM is provisionable in this zero-egress image: the baseline
         # denominators are in-process Python ports of the reference's
         # per-record NFA loop (bench_host / bench_host_serde). A JVM NFA

@@ -229,3 +229,57 @@ def shard_stats(
         return jnp.sum(leaf.reshape(n_shards, k // n_shards), axis=-1)
 
     return {k: per_shard(state[k]) for k in keys}
+
+
+def _shard_block(k: int, n_shards: int, shard: int) -> Tuple[int, int]:
+    """[lo, hi) key-column range of one shard under the contiguous block
+    partitioning every consumer of the trailing key axis shares
+    (shard_stats, the mesh layout, and migration must agree on it)."""
+    if k % n_shards:
+        raise ValueError(f"key extent {k} not divisible by {n_shards} shards")
+    if not 0 <= shard < n_shards:
+        raise ValueError(f"shard {shard} out of range ({n_shards} shards)")
+    span = k // n_shards
+    return shard * span, (shard + 1) * span
+
+
+def slice_shard_tree(
+    tree: Dict[str, jnp.ndarray], n_shards: int, shard: int
+) -> Dict[str, jnp.ndarray]:
+    """One shard's engine columns: every leaf's trailing key axis cut to
+    the shard's contiguous block (same blocks as shard_stats), keeping the
+    [..., K/n_shards] layout. The engine-state half of a shard checkpoint:
+    the slice is self-contained because per-key state never crosses key
+    lanes (SURVEY.md section 2.8 -- no cross-key coupling to sever)."""
+    lo = hi = None
+
+    def cut(leaf):
+        nonlocal lo, hi
+        lo, hi = _shard_block(leaf.shape[-1], n_shards, shard)
+        return leaf[..., lo:hi]
+
+    return jax.tree.map(cut, tree)
+
+
+def merge_shard_tree(
+    base: Dict[str, jnp.ndarray],
+    shard_tree: Dict[str, jnp.ndarray],
+    n_shards: int,
+    shard: int,
+) -> Dict[str, jnp.ndarray]:
+    """Graft a migrated shard's columns into a host tree: the inverse of
+    slice_shard_tree, writing the shard's block back over `base`'s columns
+    (bitwise -- migration must not perturb a single lane)."""
+
+    def paste(leaf, cols):
+        lo, hi = _shard_block(leaf.shape[-1], n_shards, shard)
+        if cols.shape != leaf[..., lo:hi].shape:
+            raise ValueError(
+                f"shard column shape {cols.shape} does not fit block "
+                f"[{lo}:{hi}] of leaf shape {leaf.shape}"
+            )
+        return jnp.concatenate(
+            [leaf[..., :lo], cols, leaf[..., hi:]], axis=-1
+        )
+
+    return jax.tree.map(paste, base, shard_tree)

@@ -15,7 +15,7 @@ Records in source topics carry pickled keys/values by default; pass
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple
 
 from ..faults import injection as _flt
 from ..faults.injection import CEPOverflowError, TransientFault, with_retry
@@ -83,6 +83,7 @@ class LogDriver:
         reporter: Optional[Callable[[str], None]] = None,
         on_poison: str = "quarantine",
         max_restore_attempts: int = 3,
+        partitions: Optional[Mapping[str, Sequence[int]]] = None,
     ) -> None:
         self.topology = topology
         self.log = log if log is not None else topology.log
@@ -100,6 +101,15 @@ class LogDriver:
         #: pump advancing; "raise" propagates them (fail-stop).
         self.on_poison = on_poison
         self.max_restore_attempts = max(1, max_restore_attempts)
+        #: Partition scope (the rebalance layer's task assignment): when a
+        #: topic maps to a partition list here, poll() pumps ONLY those
+        #: partitions of it -- disjoint scopes let several drivers share
+        #: the same source topics on one fleet without double-processing.
+        #: Topics absent from the map keep the discover-all default.
+        self._partition_scope: Optional[Dict[str, Tuple[int, ...]]] = (
+            {t: tuple(int(p) for p in ps) for t, ps in partitions.items()}
+            if partitions is not None else None
+        )
         self.metrics = registry if registry is not None else default_registry()
         # Children bound once to this driver's group (labels() locks per
         # resolution; poll() is the cadence path).
@@ -238,6 +248,26 @@ class LogDriver:
     def position(self, topic: str, partition: int = 0) -> int:
         return self._positions.get((topic, partition), 0)
 
+    def positions(self) -> Dict[Tuple[str, int], int]:
+        """Snapshot of every consumer position -- what a shard checkpoint
+        carries so the successor driver resumes, never replays from zero."""
+        return dict(self._positions)
+
+    def seed_positions(self, positions: Mapping[Tuple[str, int], int]) -> None:
+        """Adopt checkpointed consumer positions as already-committed.
+
+        The migration path: the successor driver is built with
+        `restore=False` (its stores come from the shard checkpoint, not a
+        changelog replay) and seeded with the source's committed
+        positions, so its first poll() continues exactly where the fenced
+        source stopped. Seeded entries count as committed -- they were
+        durable under this group before the checkpoint was cut -- so the
+        next commit() appends only genuinely new progress."""
+        for (topic, partition), pos in positions.items():
+            tp = (str(topic), int(partition))
+            self._positions[tp] = int(pos)
+            self._committed[tp] = int(pos)
+
     def drain_event_time(self, commit: bool = True) -> int:
         """End-of-stream drain for event-time gates (ISSUE 10): force-
         release every buffered record in event-time order, flush the
@@ -261,7 +291,14 @@ class LogDriver:
         processed = 0
         budget = max_records
         for topic in self.topology.source_topics:
-            partitions = self.log.partitions(topic) or [0]
+            scoped = (
+                self._partition_scope.get(topic)
+                if self._partition_scope is not None else None
+            )
+            partitions = (
+                list(scoped) if scoped is not None
+                else (self.log.partitions(topic) or [0])
+            )
             for partition in partitions:
                 start = self._positions.get((topic, partition), 0)
                 records = self.log.read(topic, partition, start, budget)

@@ -263,6 +263,15 @@ class RecordLogServer:
         self.metrics = registry if registry is not None else default_registry()
         self._lock = threading.Lock()
         self._sessions: Dict[bytes, "OrderedDict[int, int]"] = {}
+        #: Per-session high-water mark of seqs EVICTED from the bounded
+        #: dedup map: a replayed append at or below it can no longer be
+        #: verified against its original offset, so it must fail the
+        #: session rather than silently re-append (exactly-once would
+        #: break on the quiet duplicate).
+        self._evicted: Dict[bytes, int] = {}
+        #: Sessions failed after an evicted-range replay: every further
+        #: append on them errors until the producer starts a new session.
+        self._fenced: set = set()
         self._peers: Dict[int, socket.socket] = {}
         self._peer_ids = itertools.count(1)
         self._threads: List[threading.Thread] = []
@@ -501,18 +510,38 @@ class RecordLogServer:
             sid = peer["session"]
             with self._lock:
                 sess = self._sessions.get(sid) if sid is not None else None
+                if sid is not None and sid in self._fenced:
+                    raise ValueError(
+                        "session fenced after an evicted-range replay; "
+                        "start a new session"
+                    )
                 if sess is not None and seq in sess:
                     # Replayed append (the ack was lost in a disconnect):
                     # same (session, seq) -> same offset, applied once.
                     self._m_dedup.inc()
                     return ok(_I64.pack(sess[seq]))
+                if sess is not None and seq <= self._evicted.get(sid, 0):
+                    # Replay from BELOW the dedup window: its original
+                    # offset was evicted, so applied-once can no longer be
+                    # proven. Re-appending here would be a silent duplicate
+                    # -- fail the session explicitly instead (the client
+                    # surfaces a TransportError, never a quiet re-append).
+                    self._fenced.add(sid)
+                    raise ValueError(
+                        f"replayed append seq {seq} predates the dedup "
+                        f"window (evicted through seq "
+                        f"{self._evicted.get(sid, 0)}): exactly-once "
+                        "cannot be verified; session fenced"
+                    )
                 off = self.backing.append(
                     topic, key, value, timestamp=ts, partition=part
                 )
                 if sess is not None:
                     sess[seq] = off
                     while len(sess) > self.dedup_cache:
-                        sess.popitem(last=False)
+                        gone, _off = sess.popitem(last=False)
+                        if gone > self._evicted.get(sid, 0):
+                            self._evicted[sid] = gone
             return ok(_I64.pack(off))
         if op == OP_READ:
             topic = rd.str()
@@ -599,6 +628,8 @@ class SocketRecordLog:
         backoff_seed: int = 0,
         heartbeat_s: Optional[float] = None,
         connect: bool = True,
+        session: Optional[bytes] = None,
+        start_seq: int = 0,
     ) -> None:
         import os as _os
         import random as _random
@@ -615,10 +646,21 @@ class SocketRecordLog:
         self.heartbeat_s = heartbeat_s
         self.metrics = registry if registry is not None else default_registry()
         self._rng = _random.Random(backoff_seed)
-        self._session = _os.urandom(_SESSION_LEN)
+        # A migrated shard's successor adopts the source's producer
+        # session AND its seq cursor (streams/rebalance.py handoff): the
+        # server's (session, seq) dedup then spans the move. Resuming a
+        # session with a REWOUND seq would collide with the server's
+        # table, so the two travel together in the shard checkpoint.
+        if session is not None and len(session) != _SESSION_LEN:
+            raise ValueError(
+                f"session id must be {_SESSION_LEN} bytes, got {len(session)}"
+            )
+        self._session = (
+            bytes(session) if session is not None else _os.urandom(_SESSION_LEN)
+        )
         self._lock = threading.RLock()
         self._sock: Optional[socket.socket] = None
-        self._seq = 0
+        self._seq = max(0, int(start_seq))
         self._inflight: Deque[Dict[str, Any]] = deque()
         self._next_off: Dict[Tuple[str, int], int] = {}
         self._closed = False
@@ -1055,6 +1097,14 @@ class SocketRecordLog:
         t = self._hb_thread
         if t is not None:
             t.join(timeout=2.0)
+
+    def session_state(self) -> Tuple[bytes, int]:
+        """(session id, last issued seq): the idempotent-producer identity
+        a shard checkpoint carries so a migrated shard's successor client
+        resumes the SAME dedup horizon on the broker (pass both back as
+        `session=`/`start_seq=`)."""
+        with self._lock:
+            return self._session, self._seq
 
     # ----------------------------------------------------------- health
     def health(self) -> Dict[str, Any]:

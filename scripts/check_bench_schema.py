@@ -49,6 +49,10 @@ TOP_LEVEL: Dict[str, Tuple[bool, tuple]] = {
     "latency_p99_match_emit_ms": (True, OPT_NUMBER),
     "platform": (True, (str,)),
     "quick": (True, (bool,)),
+    # Explicit bench mode: full | quick | smoke (ISSUE 16) -- the perf
+    # ledger's mode_change excusal reads this; legacy artifacts derive
+    # it from quick/schema_ok, so the key is optional.
+    "mode": (False, (str,)),
     "denominator": (True, (str,)),
     "configs": (True, (dict,)),
     "metrics": (True, (dict,)),
@@ -149,6 +153,12 @@ REGRESSION_KEYS: Dict[str, tuple] = {
     # platforms (truncated wrappers).
     "platform_prev": (str, type(None)),
     "platform_cur": (str, type(None)),
+    # Bench-mode excusal (ISSUE 16): full vs --quick/--smoke rounds run
+    # deliberately different workload sizes, so cross-mode deltas are
+    # excused -- both sides' modes ride the block for auditability. None
+    # when a truncated wrapper carries no mode marker.
+    "mode_prev": (str, type(None)),
+    "mode_cur": (str, type(None)),
 }
 REGRESSION_METRIC_KEYS: Dict[str, tuple] = {
     "prev": NUMBER,
@@ -241,6 +251,13 @@ SOAK_RUN_KEYS: Dict[str, tuple] = {
     "churn_epochs": NUMBER,
     "scrapes": NUMBER,
     "scrape_errors": NUMBER,
+    # Partitioned-fleet evidence (ISSUE 16): --brokers size, seeded
+    # broker kills that landed, and the salvage-rebalance volume (all
+    # zero-ish in single-broker runs).
+    "brokers": NUMBER,
+    "broker_kills": NUMBER,
+    "rebalance_partitions_moved": NUMBER,
+    "rebalance_records_moved": NUMBER,
 }
 
 #: The SLO name set -- pinned EXACTLY (a soak that silently stops gating
@@ -252,6 +269,9 @@ SOAK_SLOS: Tuple[str, ...] = (
     "watermark_lag_s",
     "leak_drift",
     "eps_regression",
+    # Exactly-once across crashes, broker kills and shard rebalances
+    # (ISSUE 16): every sink digest unique.
+    "emission_integrity",
 )
 
 #: One SLO verdict entry: the machine-gateable shape.

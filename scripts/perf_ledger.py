@@ -20,9 +20,12 @@ into one trajectory table plus a regression verdict:
   has the config (and vs ``--baseline`` when it carries numbers); a drop
   beyond ``--tolerance`` (default 15%) flags the (config, metric) --
   EXCEPT when either side of the comparison is marked
-  ``tunnel_degraded``, or when the two rounds self-describe DIFFERENT
+  ``tunnel_degraded``, when the two rounds self-describe DIFFERENT
   platforms (a cpu round after a tpu round is an environment change,
-  not a code regression). Environment noise must not fail the check;
+  not a code regression), or when the rounds ran in different bench
+  MODES (full vs ``--quick``/``--smoke``: CI-sized workloads are a
+  deliberate size change, e.g. the r05->r06 CPU quick round). Noise
+  from the environment or the workload size must not fail the check;
   such rows are reported as excused instead, with the excuse named.
 
 Usage:
@@ -146,6 +149,13 @@ def salvage_configs(tail: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         configs[name] = obj
         claimed_until = m.end() - 1 + len(obj_text)
     top: Dict[str, Any] = {}
+    # Mode markers (ISSUE 16): a salvaged tail may still carry the
+    # smoke/quick self-description; absent markers leave mode unknown --
+    # legacy truncated wrappers never excuse themselves.
+    if '"schema_ok"' in tail:
+        top["mode"] = "smoke"
+    elif re.search(r'"quick":\s*true', tail) is not None:
+        top["mode"] = "quick"
     m = re.search(r'"tunnel_degraded":\s*(true|false)', tail)
     if m is not None:
         top["tunnel_degraded"] = m.group(1) == "true"
@@ -160,17 +170,35 @@ def salvage_configs(tail: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     return configs, top
 
 
+def artifact_mode(doc: Any) -> Optional[str]:
+    """The bench mode a raw artifact self-describes: ``smoke`` (schema
+    validation pass; implies quick), ``quick`` (CPU quick round) or
+    ``full``. Artifacts predating the explicit ``mode`` key derive it
+    from the markers those rounds already carried."""
+    if not isinstance(doc, dict):
+        return None
+    explicit = doc.get("mode")
+    if isinstance(explicit, str):
+        return explicit
+    if "schema_ok" in doc:
+        return "smoke"
+    if doc.get("quick"):
+        return "quick"
+    return "full"
+
+
 def parse_artifact(doc: Any) -> Dict[str, Any]:
     """Normalize one loaded JSON document into a round record:
-    ``{"configs": {...}, "tunnel_degraded": bool|None, "salvaged": bool,
-    "empty": bool}``. Accepts the raw bench.py artifact, the driver
-    wrapper (parsed preferred, tail salvaged), and anything else as an
-    empty round."""
+    ``{"configs": {...}, "tunnel_degraded": bool|None, "mode":
+    str|None, "salvaged": bool, "empty": bool}``. Accepts the raw
+    bench.py artifact, the driver wrapper (parsed preferred, tail
+    salvaged), and anything else as an empty round."""
     if isinstance(doc, dict) and isinstance(doc.get("configs"), dict):
         return {
             "configs": doc["configs"],
             "tunnel_degraded": doc.get("tunnel_degraded"),
             "platform": doc.get("platform"),
+            "mode": artifact_mode(doc),
             "salvaged": False,
             "empty": not doc["configs"],
         }
@@ -181,6 +209,7 @@ def parse_artifact(doc: Any) -> Dict[str, Any]:
                 "configs": parsed["configs"],
                 "tunnel_degraded": parsed.get("tunnel_degraded"),
                 "platform": parsed.get("platform"),
+                "mode": artifact_mode(parsed),
                 "salvaged": False,
                 "empty": not parsed["configs"],
             }
@@ -190,11 +219,12 @@ def parse_artifact(doc: Any) -> Dict[str, Any]:
             "configs": configs,
             "tunnel_degraded": top.get("tunnel_degraded"),
             "platform": top.get("platform"),
+            "mode": top.get("mode"),
             "salvaged": bool(configs),
             "empty": not configs,
         }
     return {"configs": {}, "tunnel_degraded": None, "platform": None,
-            "salvaged": False, "empty": True}
+            "mode": None, "salvaged": False, "empty": True}
 
 
 def load_artifact(path: str) -> Dict[str, Any]:
@@ -253,6 +283,7 @@ def build_ledger(rounds: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "round": rec["round"],
                 "path": rec.get("path"),
                 "tunnel_degraded": rec["tunnel_degraded"],
+                "mode": rec.get("mode"),
                 "salvaged": rec["salvaged"],
                 "empty": rec["empty"],
                 "n_configs": len(rec["configs"]),
@@ -277,6 +308,14 @@ def platform_mismatch(a: Optional[str], b: Optional[str]) -> bool:
     return a is not None and b is not None and a != b
 
 
+def mode_change(a: Optional[str], b: Optional[str]) -> bool:
+    """A quick/smoke round on either side of a different-mode round: a
+    deliberate workload-size change (CI-sized CPU passes vs the full
+    bench), not a code regression. Two unknown/full rounds never excuse
+    -- only an explicit quick/smoke marker does."""
+    return a != b and (a in ("quick", "smoke") or b in ("quick", "smoke"))
+
+
 def find_regressions(
     ledger: Dict[str, Any],
     rounds: List[Dict[str, Any]],
@@ -285,12 +324,14 @@ def find_regressions(
     """Flag (config, metric, round) drops beyond `tolerance` vs the
     previous round carrying the metric. Entries where either side's
     round is tunnel_degraded -- or the two rounds self-describe
-    DIFFERENT platforms (cpu vs tpu: an environment delta, not a code
+    DIFFERENT platforms (cpu vs tpu) or DIFFERENT bench modes
+    (full vs quick/smoke: a deliberate workload-size delta, not a code
     regression) -- come back with ``"excused": True``: reported, never
     failed on."""
     out: List[Dict[str, Any]] = []
     degraded = [bool(rec["tunnel_degraded"]) for rec in rounds]
     platforms = [rec.get("platform") for rec in rounds]
+    modes = [rec.get("mode") for rec in rounds]
     names = [rec["round"] for rec in rounds]
     for config, series in ledger["table"].items():
         for metric in REGRESSION_METRICS:
@@ -308,6 +349,8 @@ def find_regressions(
                             excuse = "tunnel_degraded"
                         elif platform_mismatch(platforms[prev_i], platforms[i]):
                             excuse = "platform_change"
+                        elif mode_change(modes[prev_i], modes[i]):
+                            excuse = "mode_change"
                         out.append(
                             {
                                 "config": config,
@@ -344,8 +387,15 @@ def compare_artifacts(
     deg_cur = bool(cur.get("tunnel_degraded"))
     plat_prev = prev.get("platform")
     plat_cur = cur.get("platform")
+    # Raw artifacts skip parse_artifact above, so derive their mode from
+    # the markers they carry; normalized round records already have it.
+    mode_prev = prev["mode"] if "mode" in prev else artifact_mode(prev)
+    mode_cur = cur["mode"] if "mode" in cur else artifact_mode(cur)
     excused = (
-        deg_prev or deg_cur or platform_mismatch(plat_prev, plat_cur)
+        deg_prev
+        or deg_cur
+        or platform_mismatch(plat_prev, plat_cur)
+        or mode_change(mode_prev, mode_cur)
     )
     per_config: Dict[str, Any] = {}
     regressed = False
@@ -392,6 +442,8 @@ def compare_artifacts(
         "tunnel_degraded_cur": deg_cur,
         "platform_prev": plat_prev,
         "platform_cur": plat_cur,
+        "mode_prev": mode_prev,
+        "mode_cur": mode_cur,
     }
 
 

@@ -77,6 +77,13 @@ def pytest_configure(config):
         "markers",
         "transport: wire-transport suite (loopback sockets, CPU-safe)",
     )
+    # `rebalance` mirrors `transport`: rides tier-1, and
+    # `pytest -m rebalance` selects the partitioned-fleet/shard-migration
+    # suite (broker routing, shard checkpoints, live migration).
+    config.addinivalue_line(
+        "markers",
+        "rebalance: partitioned-fleet and shard-migration suite (CPU-safe)",
+    )
     config.addinivalue_line("markers", "slow: excluded from tier-1")
     # `lint` selects the static-analysis gate (tests/test_lint.py):
     # ceplint over the full package, mutation fixtures, pragma/baseline

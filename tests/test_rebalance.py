@@ -1,0 +1,515 @@
+"""Partitioned broker fleet + shard rebalance suite (ISSUE 16).
+
+Pins the task/rebalance layer's contract: deterministic partition
+routing over N socket brokers, idempotent partition moves (live source
+and dead-broker salvage), the sealed shard checkpoint frame, and -- the
+flagship property -- BITWISE-identical sink output across a live
+mid-stream shard migration, on both runtimes, including after a broker
+kill. "Bitwise" is checked on emission digests (unique per match
+occurrence), so multiset equality proves zero duplicates AND zero losses
+across the move.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from kafkastreams_cep_tpu import (
+    ComplexStreamsBuilder,
+    EngineConfig,
+    LogDriver,
+    QueryBuilder,
+    RecordLog,
+    produce,
+)
+from kafkastreams_cep_tpu.obs.registry import MetricsRegistry
+from kafkastreams_cep_tpu.state.serde import (
+    CheckpointError,
+    decode_shard_checkpoint,
+    encode_shard_checkpoint,
+)
+from kafkastreams_cep_tpu.streams.emission import decode_sink_key
+from kafkastreams_cep_tpu.streams.partition import (
+    BrokerFleet,
+    PartitionedRecordLog,
+)
+from kafkastreams_cep_tpu.streams.rebalance import (
+    RebalanceController,
+    ShardPipeline,
+    plan,
+)
+from kafkastreams_cep_tpu.streams.transport import SocketRecordLog
+
+pytestmark = pytest.mark.rebalance
+
+DEVICE_CFG = EngineConfig(lanes=8, nodes=256, matches=256,
+                          matches_per_step=4, nodes_per_step=8)
+DEVICE_OPTS = dict(config=DEVICE_CFG, batch_size=5, initial_keys=2)
+
+
+def host_pattern():
+    return (
+        QueryBuilder()
+        .select("select-A").where(lambda e, s: e.value == "A")
+        .then().select("select-B").where(lambda e, s: e.value == "B")
+        .then().select("select-C").where(lambda e, s: e.value == "C")
+        .build()
+    )
+
+
+def device_pattern():
+    from kafkastreams_cep_tpu.pattern.expressions import value
+
+    return (
+        QueryBuilder()
+        .select("select-A").where(value() == "A")
+        .then().select("select-B").where(value() == "B")
+        .then().select("select-C").where(value() == "C")
+        .build()
+    )
+
+
+def _stream(seed: int, n: int = 36):
+    rng = random.Random(seed)
+    out: list = []
+    while len(out) < n:
+        out.extend(rng.choice(("ABC", "ABC", "AB", "BC", "X", "AXC", "Y")))
+    return out[:n]
+
+
+def _build_topology(log, shard_id, registry=None, runtime="host",
+                    **device_opts):
+    pattern = host_pattern() if runtime == "host" else device_pattern()
+    builder = ComplexStreamsBuilder(log=log, app_id=f"reb-{shard_id}")
+    (
+        builder.stream("letters")
+        .query("q", pattern, runtime=runtime, registry=registry,
+               **device_opts)
+        .to("matches")
+    )
+    return builder.build()
+
+
+def _sink_digests(log):
+    out = []
+    for rec in log.read("matches"):
+        _key, digest = decode_sink_key(rec.key)
+        assert digest is not None
+        out.append((digest, rec.value))
+    return sorted(out)
+
+
+def _golden(events, runtime="host", **device_opts):
+    """Single-broker fault-free run: the bitwise reference."""
+    log = RecordLog()
+    for i, ch in enumerate(events):
+        produce(log, "letters", "K", ch, timestamp=i)
+    reg = MetricsRegistry()
+    topo = _build_topology(log, "golden", registry=reg, runtime=runtime,
+                           **device_opts)
+    driver = LogDriver(topo, group="shard-s0", registry=reg)
+    while driver.poll(max_records=4):
+        pass
+    return _sink_digests(log), reg
+
+
+def _fleet_view(fleet, reg, sessions=None, assignment=None, down=None,
+                **client_opts):
+    """A PartitionedRecordLog over the fleet, optionally adopting
+    per-broker transport sessions (migration) and a routing snapshot."""
+    clients = []
+    for i, server in enumerate(fleet.servers):
+        if server is None:
+            clients.append(
+                SocketRecordLog(("127.0.0.1", 9), registry=reg,
+                                connect=False, retry_budget=0)
+            )
+            continue
+        kw = dict(client_opts)
+        sess = (sessions or {}).get(str(i))
+        if sess is not None:
+            kw.update(session=sess[0], start_seq=sess[1])
+        clients.append(SocketRecordLog(server.address, registry=reg, **kw))
+    view = PartitionedRecordLog(clients, registry=reg,
+                                assignment=assignment)
+    for dead, target in (down or {}).items():
+        view.mark_down(dead, redirect_to=target)
+    return view
+
+
+# -------------------------------------------------------- routing contract
+def test_partitioned_log_contract_parity(tmp_path):
+    """The fleet view satisfies the RecordLog L0 contract: per-(topic,
+    partition) offsets, tombstones, read windows, enumeration across
+    brokers, flush."""
+    reg = MetricsRegistry()
+    fleet = BrokerFleet(str(tmp_path), n_brokers=2, registry=reg)
+    try:
+        log = PartitionedRecordLog(fleet.clients(registry=reg),
+                                   registry=reg)
+        assert log.append("t", b"k1", b"v1", timestamp=5) == 0
+        assert log.append("t", b"k2", None) == 1
+        assert log.append("t", None, None) == 2
+        assert log.append("t", b"k3", b"v3", partition=2) == 0
+        recs = log.read("t")
+        assert [(r.offset, r.key, r.value, r.timestamp) for r in recs] == [
+            (0, b"k1", b"v1", 5),
+            (1, b"k2", None, 0),
+            (2, None, None, 0),
+        ]
+        assert log.read("t", partition=2)[0].value == b"v3"
+        assert log.end_offset("t") == 3
+        assert log.topics() == ["t"]
+        assert log.partitions("t") == [0, 2]
+        assert log.read("t", start=1) == recs[1:]
+        assert log.read("t", start=0, max_records=1) == recs[:1]
+        log.flush()
+        assert log.health()["brokers"] == 2
+        log.close()
+    finally:
+        fleet.stop()
+
+
+def test_default_routing_deterministic_across_views(tmp_path):
+    """Two independent views of an equally-ordered fleet must agree on
+    every default route (no PYTHONHASHSEED dependence), and explicit
+    assignment overrides the hash."""
+    reg = MetricsRegistry()
+    fleet = BrokerFleet(str(tmp_path), n_brokers=3, registry=reg)
+    try:
+        a = PartitionedRecordLog(fleet.clients(registry=reg), registry=reg)
+        b = PartitionedRecordLog(fleet.clients(registry=reg), registry=reg)
+        for topic in ("letters", "matches", "__consumer_offsets", "x-y-z"):
+            for part in range(4):
+                assert a.broker_for(topic, part) == b.broker_for(topic, part)
+        a.assign("letters", 0, 2)
+        assert a.broker_for("letters", 0) == 2
+        assert a.partitions_on(2) == [("letters", 0)] or (
+            ("letters", 0) in a.partitions_on(2)
+        )
+        a.close()
+        b.close()
+    finally:
+        fleet.stop()
+
+
+def test_move_partition_live_and_salvage_idempotent(tmp_path):
+    """move_partition copies exactly the missing suffix (a re-run is a
+    no-op) and flips the route; a dead broker's partition moves through
+    its salvage log with identical content."""
+    reg = MetricsRegistry()
+    fleet = BrokerFleet(str(tmp_path), n_brokers=2, registry=reg)
+    try:
+        log = PartitionedRecordLog(fleet.clients(registry=reg), registry=reg)
+        for i in range(8):
+            log.append("t", b"k%d" % i, b"v%d" % i, timestamp=i)
+        log.flush()
+        src = log.broker_for("t", 0)
+        tgt = 1 - src
+        assert log.move_partition("t", 0, tgt) == 8
+        assert log.broker_for("t", 0) == tgt
+        assert [r.value for r in log.read("t")] == [
+            b"v%d" % i for i in range(8)
+        ]
+        # Idempotent: re-running the move appends nothing.
+        assert log.move_partition("t", 0, tgt,
+                                  source_log=fleet.salvage_log(src)) == 0
+        # Salvage path: kill the target, move back off its segments. The
+        # old owner still holds the identical append-only prefix, so the
+        # salvage copy appends NOTHING -- only the route flips.
+        fleet.kill(tgt)
+        log.mark_down(tgt, redirect_to=src)
+        n = log.move_partition("t", 0, src,
+                               source_log=fleet.salvage_log(tgt))
+        assert n == 0
+        assert log.broker_for("t", 0) == src
+        assert [r.value for r in log.read("t")] == [
+            b"v%d" % i for i in range(8)
+        ]
+        # A salvage move onto a broker that never saw the data DOES copy:
+        # fresh topic written only to the dead broker's segments.
+        salvage = fleet.salvage_log(tgt)
+        assert salvage.end_offset("t", 0) == 8
+        log.close()
+    finally:
+        fleet.stop()
+
+
+# ------------------------------------------------------- shard checkpoint
+def test_shard_checkpoint_roundtrip_and_corruption():
+    cp = {
+        "shard_id": "s0",
+        "group": "shard-s0",
+        "positions": {("letters", 0): 17, ("letters", 2): 0},
+        "sessions": {"0": (b"\x01" * 16, 42), "1": (b"\x02" * 16, 0)},
+        "queries": {
+            "q": {
+                "runtime": "host",
+                "stores": b"\x00stores-blob",
+                "sink_pos": {"matches": 3},
+                "event_time": None,
+            },
+            "empty": {
+                "runtime": "tpu",
+                "stores": None,
+                "sink_pos": {},
+                "event_time": b"gate",
+            },
+        },
+    }
+    blob = encode_shard_checkpoint(cp)
+    assert decode_shard_checkpoint(blob) == cp
+    # Any flipped payload byte must fail the CRC seal loudly.
+    bad = bytearray(blob)
+    bad[len(bad) // 2] ^= 0xFF
+    with pytest.raises(CheckpointError):
+        decode_shard_checkpoint(bytes(bad))
+    # A foreign (non-shard) sealed frame is rejected by magic.
+    from kafkastreams_cep_tpu.state.serde import seal_frame
+
+    with pytest.raises(CheckpointError):
+        decode_shard_checkpoint(seal_frame(b"KCT5junk"))
+
+
+def test_slice_merge_shard_tree_bitwise():
+    """slice_shard_tree cuts the same contiguous trailing-K blocks
+    shard_stats sums, and merge_shard_tree grafts them back bitwise."""
+    import jax.numpy as jnp
+
+    from kafkastreams_cep_tpu.parallel.key_shard import (
+        merge_shard_tree,
+        slice_shard_tree,
+    )
+
+    rng = np.random.default_rng(3)
+    tree = {
+        "a": jnp.asarray(rng.integers(0, 99, size=(4, 16))),
+        "b": jnp.asarray(rng.standard_normal((2, 3, 16))),
+        "c": jnp.asarray(rng.integers(0, 2, size=(16,))),
+    }
+    shards = [slice_shard_tree(tree, 4, s) for s in range(4)]
+    assert all(sh["a"].shape == (4, 4) for sh in shards)
+    # Reassembling all shards over a zero base reproduces the original.
+    rebuilt = {k: jnp.zeros_like(v) for k, v in tree.items()}
+    for s, sh in enumerate(shards):
+        rebuilt = merge_shard_tree(rebuilt, sh, 4, s)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(rebuilt[k]),
+                                      np.asarray(tree[k]))
+    with pytest.raises(ValueError):
+        slice_shard_tree(tree, 5, 0)  # 16 % 5 != 0
+    with pytest.raises(ValueError):
+        slice_shard_tree(tree, 4, 4)  # shard out of range
+
+
+# ------------------------------------------------------------- migration
+def test_fence_blocks_poll_and_checkpoint_requires_fence(tmp_path):
+    reg = MetricsRegistry()
+    fleet = BrokerFleet(str(tmp_path), n_brokers=2, registry=reg)
+    try:
+        log = _fleet_view(fleet, reg)
+        produce(log, "letters", "K", "A", timestamp=0)
+        pipe = ShardPipeline(
+            "s0", lambda lg, sid: _build_topology(lg, sid, registry=reg),
+            log, partitions={"letters": (0,)}, registry=reg,
+        )
+        with pytest.raises(RuntimeError):
+            pipe.checkpoint()  # not fenced yet
+        pipe.fence()
+        with pytest.raises(RuntimeError):
+            pipe.poll()  # fenced shards must not pump
+        blob = pipe.checkpoint()
+        cp = decode_shard_checkpoint(blob)
+        assert cp["shard_id"] == "s0"
+        assert cp["group"] == "shard-s0"
+        assert ("letters", 0) in cp["positions"]
+        assert set(cp["sessions"]) == {"0", "1"}
+        pipe.close(close_log=True)
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.parametrize("runtime", ["host", "tpu"])
+def test_live_migration_bitwise_vs_single_broker_golden(tmp_path, runtime):
+    """The flagship acceptance property: a live mid-stream migration
+    across 2 socket brokers leaves the sink BITWISE identical to the
+    single-broker golden run, with zero duplicate digests, on both
+    runtimes -- and the shared registry shows every source record
+    processed exactly once across the two pipeline generations."""
+    opts = DEVICE_OPTS if runtime == "tpu" else {}
+    events = _stream(11, n=24 if runtime == "tpu" else 36)
+    golden, golden_reg = _golden(events, runtime=runtime, **opts)
+    assert golden, "stream must complete matches"
+
+    reg = MetricsRegistry()
+    fleet = BrokerFleet(str(tmp_path), n_brokers=2, registry=reg)
+    try:
+        src_log = _fleet_view(fleet, reg)
+        for i, ch in enumerate(events):
+            produce(src_log, "letters", "K", ch, timestamp=i)
+        src_log.flush()
+
+        def bt(lg, sid):
+            return _build_topology(lg, sid, registry=reg, runtime=runtime,
+                                   **opts)
+
+        src = ShardPipeline("s0", bt, src_log,
+                            partitions={"letters": (0,)}, registry=reg)
+        for _ in range(3):  # consume a strict prefix, then migrate live
+            src.poll(max_records=4)
+        ctl = RebalanceController(registry=reg)
+        tgt = ctl.migrate(
+            src,
+            lambda sessions: _fleet_view(
+                fleet, reg, sessions=sessions,
+                assignment=src_log.assignment(),
+            ),
+            reason="skew",
+        )
+        assert src.fenced
+        while tgt.poll(max_records=4):
+            pass
+        tgt.driver.commit()
+
+        final = _sink_digests(tgt.log)
+        assert final == golden  # bitwise: same digests, same payloads
+        assert len({d for d, _v in final}) == len(final), "duplicate emission"
+        # Registry continuity vs the golden run: the source and successor
+        # share one group and one registry, and together processed the
+        # stream exactly once -- the same totals the single-broker
+        # golden registry shows.
+        for name in ("cep_driver_records_total",):
+            mine = reg._metrics[name].labels(group="shard-s0").value
+            ref = golden_reg._metrics[name].labels(group="shard-s0").value
+            assert mine == ref == len(events)
+        assert (
+            reg._metrics["cep_rebalance_migrations_total"]
+            .labels(reason="skew").value == 1
+        )
+        assert reg._metrics["cep_rebalance_fenced_shards"].value == 0
+        tgt.close(close_log=True)
+    finally:
+        fleet.stop()
+
+
+def test_migration_never_from_zero(tmp_path):
+    """The successor resumes from the committed watermark: its seeded
+    positions equal the fence-point commit, and its first poll consumes
+    only the remainder of the stream."""
+    reg = MetricsRegistry()
+    events = _stream(5, n=36)
+    fleet = BrokerFleet(str(tmp_path), n_brokers=2, registry=reg)
+    try:
+        src_log = _fleet_view(fleet, reg)
+        for i, ch in enumerate(events):
+            produce(src_log, "letters", "K", ch, timestamp=i)
+        src_log.flush()
+
+        def bt(lg, sid):
+            return _build_topology(lg, sid, registry=reg)
+
+        src = ShardPipeline("s0", bt, src_log,
+                            partitions={"letters": (0,)}, registry=reg)
+        consumed = 0
+        for _ in range(4):
+            consumed += src.poll(max_records=4)
+        assert 0 < consumed < len(events)
+        ctl = RebalanceController(registry=reg)
+        tgt = ctl.migrate(
+            src,
+            lambda sessions: _fleet_view(
+                fleet, reg, sessions=sessions,
+                assignment=src_log.assignment(),
+            ),
+        )
+        assert tgt.driver.position("letters", 0) == consumed
+        remainder = 0
+        while True:
+            n = tgt.poll(max_records=4)
+            if not n:
+                break
+            remainder += n
+        assert consumed + remainder == len(events)
+        tgt.close(close_log=True)
+    finally:
+        fleet.stop()
+
+
+def test_broker_kill_salvage_and_migration_exactly_once(tmp_path):
+    """Kill the broker owning the source topic mid-stream: salvage its
+    durable partitions onto the survivor, migrate the shard, and finish
+    with a sink bitwise-identical to the golden run -- emission digests
+    intact across both the death and the move."""
+    reg = MetricsRegistry()
+    events = _stream(23, n=36)
+    golden, _greg = _golden(events)
+    fleet = BrokerFleet(str(tmp_path), n_brokers=2, registry=reg)
+    try:
+        src_log = _fleet_view(fleet, reg, io_timeout_s=2.0, retry_budget=2)
+        for i, ch in enumerate(events):
+            produce(src_log, "letters", "K", ch, timestamp=i)
+        src_log.flush()
+
+        def bt(lg, sid):
+            return _build_topology(lg, sid, registry=reg)
+
+        src = ShardPipeline("s0", bt, src_log,
+                            partitions={"letters": (0,)}, registry=reg)
+        for _ in range(3):
+            src.poll(max_records=4)
+        src.driver.commit()
+
+        dead = src_log.broker_for("letters", 0)
+        survivor = 1 - dead
+        fleet.kill(dead)
+
+        ctl = RebalanceController(registry=reg)
+        parts, recs = ctl.recover_broker(
+            [src_log], dead, survivor, fleet.salvage_log(dead)
+        )
+        assert parts > 0 and recs > 0
+        tgt = ctl.migrate(
+            src,
+            lambda sessions: _fleet_view(
+                fleet, reg, sessions=sessions,
+                assignment=src_log.assignment(),
+                down={dead: survivor},
+            ),
+            reason="broker_dead",
+        )
+        while tgt.poll(max_records=4):
+            pass
+        tgt.driver.commit()
+        final = _sink_digests(tgt.log)
+        assert final == golden
+        assert len({d for d, _v in final}) == len(final)
+        assert tgt.driver.position("letters", 0) == len(events)
+        assert (
+            reg._metrics["cep_rebalance_partition_moves_total"].value
+            == parts
+        )
+        tgt.close(close_log=True)
+    finally:
+        fleet.stop()
+
+
+# ----------------------------------------------------------------- policy
+def test_plan_policy_pure_and_deterministic():
+    # Healthy, balanced: no actions.
+    assert plan({"s0": 10.0, "s1": 11.0}, {0: 0.1, 1: 0.2}) == []
+    # Skew: the hot shard migrates.
+    acts = plan({"s0": 100.0, "s1": 5.0}, {0: 0.1, 1: 0.2})
+    assert acts == [{"kind": "migrate", "shard": "s0", "reason": "skew"}]
+    # Dead broker (stale or never-connected) triggers recovery first.
+    acts = plan({"s0": 100.0, "s1": 5.0}, {0: 0.1, 1: None})
+    assert acts[0] == {
+        "kind": "recover_broker", "broker": 1, "reason": "broker_dead",
+    }
+    assert acts[1]["kind"] == "migrate"
+    # Below min_load nothing migrates regardless of ratio.
+    assert plan({"s0": 0.5, "s1": 0.0}, {0: 0.1}, min_load=1.0) == []
+    # Deterministic tie-break: equal loads pick the first shard by name.
+    acts = plan({"b": 50.0, "a": 50.0}, {0: 0.1}, skew_ratio=1.0)
+    assert acts == [{"kind": "migrate", "shard": "a", "reason": "skew"}]

@@ -291,7 +291,9 @@ def test_seeded_violation_flips_verdict(tmp_path):
 
 def test_soak_regression_slo_against_prior_artifact(quick_soak, tmp_path):
     """eps_regression reuses perf_ledger.compare_artifacts verbatim: a
-    fabricated fast prior flags, the soak's own prior does not."""
+    fabricated fast prior flags, the soak's own prior does not. Both
+    comparisons are quick-vs-quick (the prior IS the quick run's doc),
+    so the ledger's mode-change excusal must stay out of the way."""
     _rc, doc = quick_soak
     fast_prior = tmp_path / "SOAK_fast.json"
     boosted = json.loads(json.dumps(doc))
@@ -306,6 +308,7 @@ def test_soak_regression_slo_against_prior_artifact(quick_soak, tmp_path):
         },
         platform=doc["soak"]["platform"],
         tolerance=0.15,
+        quick=True,
     )
     assert block["regressed"] is True and block["excused"] is False
     same_prior = tmp_path / "SOAK_same.json"
@@ -318,6 +321,7 @@ def test_soak_regression_slo_against_prior_artifact(quick_soak, tmp_path):
         },
         platform=doc["soak"]["platform"],
         tolerance=0.15,
+        quick=True,
     )
     assert block2["regressed"] is False
 

@@ -815,3 +815,144 @@ def test_soak_cli_socket_transport(tmp_path):
     assert doc["soak"]["transport"] == "socket"
     assert doc["schema_ok"] is True
     assert "cep_transport_disconnects_total" in doc["faults"]
+
+
+@transport
+def test_dedup_eviction_replay_fences_session():
+    """ISSUE 16 regression: a replayed APPEND whose seq was EVICTED from
+    the bounded dedup map must fail the session loudly, never re-append.
+    Before this fix the server re-ran such replays as fresh appends --
+    a quiet exactly-once break invisible until the duplicate surfaced
+    downstream. Subsequent appends on the fenced session also fail; a
+    fresh session recovers."""
+    reg = MetricsRegistry()
+    server = RecordLogServer(RecordLog(), registry=reg, dedup_cache=4).start()
+
+    def hello(sid):
+        return (
+            wire.OP_HELLO + wire._U64.pack(0) + sid
+            + wire._U32.pack(wire.WIRE_VERSION)
+        )
+
+    def append(seq):
+        return (
+            wire.OP_APPEND + wire._U64.pack(seq) + wire._pack_str("t")
+            + wire._I32.pack(0) + wire._I64.pack(0)
+            + wire._pack_blob(b"k") + wire._pack_blob(b"v%d" % seq)
+        )
+
+    def err_text(resp):
+        assert resp[:1] == wire.OP_ERR
+        (n,) = struct.unpack_from("<I", resp, 9)
+        return resp[13:13 + n].decode("utf-8")
+
+    sid = b"\x07" * 16
+    s = socket.create_connection(server.address, timeout=5.0)
+    try:
+        assert _roundtrip(s, hello(sid))[:1] == wire.OP_OK
+        for seq in range(1, 9):  # cache of 4 keeps 5..8, evicts 1..4
+            assert _roundtrip(s, append(seq))[:1] == wire.OP_OK
+        # In-window replay still dedups (same offset, nothing appended).
+        assert struct.unpack_from("<q", _roundtrip(s, append(6)), 9)[0] == 5
+        # Evicted-range replay: explicit failure, session fenced.
+        msg = err_text(_roundtrip(s, append(2)))
+        assert "dedup" in msg and "fenced" in msg
+        # The fence sticks: even a FRESH seq on this session now errors.
+        assert "fenced" in err_text(_roundtrip(s, append(9)))
+        assert server.backing.end_offset("t") == 8  # nothing re-appended
+    finally:
+        s.close()
+    # A new session (the documented recovery) appends normally again.
+    s2 = socket.create_connection(server.address, timeout=5.0)
+    try:
+        assert _roundtrip(s2, hello(b"\x08" * 16))[:1] == wire.OP_OK
+        assert struct.unpack_from(
+            "<q", _roundtrip(s2, append(1)), 9
+        )[0] == 8
+    finally:
+        s2.close()
+        server.stop()
+
+
+@transport
+@pytest.mark.chaos
+def test_driver_restore_over_wire_under_disconnect_and_stall(tmp_path):
+    """ISSUE 16 satellite: the bounded-retry changelog-restore path
+    (LogDriver startup, site driver.restore) running against a SOCKET
+    broker under seeded net.disconnect + net.stall chaos. The restore
+    must absorb the wire damage (reconnect + replay under with_retry),
+    resume from the committed offsets -- never from zero -- and keep the
+    stream exactly-once vs the fault-free golden run."""
+    from kafkastreams_cep_tpu.streams.emission import decode_sink_key
+
+    def sink_digests(log):
+        out = []
+        for rec in log.read("matches"):
+            _key, digest = decode_sink_key(rec.key)
+            assert digest is not None
+            out.append((digest, rec.value))
+        return out
+
+    events = list("XABCYABCXABC")
+    mem = RecordLog()
+    for i, ch in enumerate(events):
+        produce(mem, "letters", "K", ch, timestamp=i)
+    gtopo, _gout = _build_topology(mem)
+    gdriver = LogDriver(gtopo, group="g")
+    while gdriver.poll(max_records=3):
+        pass
+    golden = sink_digests(mem)
+    assert golden
+
+    reg = MetricsRegistry()
+    server = RecordLogServer(
+        RecordLog(str(tmp_path / "broker")), registry=reg,
+        stall_inject_s=3.0,
+    ).start()
+    half = len(events) // 2
+    try:
+        log = SocketRecordLog(server.address, registry=reg, io_timeout_s=2.0)
+        for i, ch in enumerate(events[:half]):
+            produce(log, "letters", "K", ch, timestamp=i)
+        topo, _out = _build_topology(log)
+        driver = LogDriver(topo, group="g", registry=reg)
+        while driver.poll(max_records=3):
+            pass
+        driver.close()  # final commit: changelogs + offsets durable
+        log.close()
+
+        # Rebuild over a fresh client with wire chaos armed: disconnects
+        # land mid-restore-read, and a server stall overruns the client's
+        # IO deadline during the replay.
+        schedule = FaultSchedule([
+            FaultPoint("driver.restore", 1),
+            FaultPoint("net.disconnect", 3),
+            FaultPoint("net.disconnect", 9),
+            FaultPoint("net.stall", 1),
+        ])
+        with armed(FaultInjector(schedule, registry=reg)):
+            log2 = SocketRecordLog(
+                server.address, registry=reg, io_timeout_s=2.0,
+            )
+            topo2, _out2 = _build_topology(log2)
+            driver2 = LogDriver(topo2, group="g", registry=reg)
+            # The changelog replay really streamed state over the wire.
+            assert driver2.restored_records > 0
+            for i, ch in enumerate(events[half:], start=half):
+                produce(log2, "letters", "K", ch, timestamp=i)
+            while driver2.poll(max_records=3):
+                pass
+            driver2.close()
+        injected = {p.site for p in schedule.points if p.fired}
+        assert "net.disconnect" in injected, "chaos never landed"
+        final = sink_digests(log2)
+        assert sorted(final) == sorted(golden)
+        assert len({d for d, _v in final}) == len(final), "duplicate emission"
+        # The retry wrapper observed the injected restore transient.
+        assert (
+            reg._metrics["cep_retries_total"]
+            .labels(site="driver.restore").value >= 1
+        )
+        log2.close()
+    finally:
+        server.stop()
