@@ -370,17 +370,22 @@ def bench_device_single(
 def bench_device_batched(
     pattern_fn: Callable, schema_fn, stream_fn: Callable,
     config: EngineConfig, n_keys: int, batch: int, n_batches: int,
+    sink_format: str = "objects",
 ) -> Dict[str, Any]:
     """Multi-key batched engine: the throughput path.
 
     Engine-only timing pre-packs every [T, K] batch (ingest packing is a
     pipelined host-side stage -- measured separately as end2end).
+    sink_format="json"/"arrow" (ISSUE 17) swaps the drain's decode stage
+    for the native bytes emitter -- same tensors, SinkMatch out -- so the
+    eps/e2e/latency deltas vs the objects run isolate decode cost.
     """
     schema = schema_fn() if schema_fn else None
     query = compile_query(compile_pattern(pattern_fn()), schema)
     bat = BatchedDeviceNFA(
         query, keys=[f"k{i}" for i in range(n_keys)], config=config,
         engine=ARGS.engine, provenance_sample=PROVENANCE_SAMPLE,
+        sink_format=sink_format,
         # Arm cost_analysis() estimates here (off by default: the extra
         # lowering per signature doubles trace time): the bench pays one
         # retrace per program so the artifact's `compile` block carries
@@ -485,7 +490,7 @@ def bench_device_batched(
         e2e_eps=e2e_n / e2e_dt, e2e_matches=e2e_matches,
         lat_matches=lat_matches,
         keys=n_keys, batch=batch, lanes=config.lanes, engine=bat.engine,
-        drain_mode=bat.drain_mode,
+        drain_mode=bat.drain_mode, sink_format=bat.sink_format,
         pack_eps=(n_warm + n_batches) * batch * n_keys / pack_s,
         p50_batch_ms=float(np.percentile(lat_ms, 50)),
         p99_batch_ms=float(np.percentile(lat_ms, 99)),
@@ -1096,6 +1101,113 @@ def bench_transport_loopback() -> Dict[str, Any]:
     )
 
 
+def bench_sink_bytes() -> Dict[str, Any]:
+    """Smoke-only sink-to-bytes pass (ISSUE 17): the SAME stock stream
+    through three flat-drain engines -- sink_format "objects" (Sequence
+    decode), "json" and "arrow" (native bytes emission) -- with byte and
+    emission-digest parity pinned against the object path in-pass, and a
+    DrainController armed on the json engine (its chosen knobs ride the
+    artifact's `sink` block for the perf ledger).
+
+    eps here compares DECODE paths, so the timed window is advance +
+    terminal drain/decode together -- unlike the throughput configs,
+    whose engine-only dt excludes the drain stage."""
+    import hashlib
+
+    from kafkastreams_cep_tpu.native import load_decoder
+    from kafkastreams_cep_tpu.parallel import DrainController
+    from kafkastreams_cep_tpu.streams.emission import (
+        identity_prefix,
+        sequence_ident_frames,
+        sequence_identity,
+    )
+    from kafkastreams_cep_tpu.streams.serde import (
+        sequence_to_arrow_ipc,
+        sequence_to_json_bytes,
+    )
+
+    n_keys, batch, n_batches = 4, 32, 5
+    cfg = EngineConfig(lanes=64, nodes=1024, matches=8192,
+                       matches_per_step=64, nodes_per_step=64)
+    rng = random.Random(23)
+    streams = {
+        f"k{i}": stock_stream(rng, batch * n_batches) for i in range(n_keys)
+    }
+    chunks = [
+        {k: s[b * batch: (b + 1) * batch] for k, s in streams.items()}
+        for b in range(n_batches)
+    ]
+    ref = {"json": sequence_to_json_bytes, "arrow": sequence_to_arrow_ipc}
+    controller_state: Dict[str, Any] = {}
+
+    def _run(fmt: str):
+        bat = BatchedDeviceNFA(
+            compile_query(compile_pattern(stock_pattern()), stock_schema()),
+            keys=list(streams), config=cfg, drain_mode="flat",
+            sink_format=fmt, query_name="stock_rising",
+        )
+        ctl = DrainController(bat) if fmt == "json" else None
+        # Warm chunk compiles advance/post + the drain/decode path; its
+        # matches still count (all three runs see identical streams).
+        bat.advance_packed(bat.pack(chunks[0]), decode=False)
+        out = {k: list(v) for k, v in bat.drain().items()}
+        t0 = time.perf_counter()
+        for chunk in chunks[1:]:
+            bat.advance_packed(bat.pack(chunk), decode=False)
+            if ctl is not None:
+                ctl.observe(events=batch * n_keys)
+        for k, v in bat.drain().items():
+            out.setdefault(k, []).extend(v)
+        dt = time.perf_counter() - t0
+        if ctl is not None:
+            controller_state.update(ctl.observe())
+        return out, (n_batches - 1) * batch * n_keys / dt
+
+    runs = {fmt: _run(fmt) for fmt in ("objects", "json", "arrow")}
+    objects = runs["objects"][0]
+    n_matches = sum(len(v) for v in objects.values())
+    counts_equal = all(
+        {k: len(v) for k, v in runs[f][0].items()}
+        == {k: len(v) for k, v in objects.items()}
+        for f in ("json", "arrow")
+    )
+    parity: Dict[str, bool] = {}
+    sink_bytes: Dict[str, int] = {}
+    digest_parity = counts_equal
+    for fmt in ("json", "arrow"):
+        ok = counts_equal
+        total = 0
+        for k, seqs in objects.items():
+            for seq, sm in zip(seqs, runs[fmt][0].get(k, ())):
+                total += len(sm.payload)
+                ok = ok and sm.payload == ref[fmt](seq)
+                ok = ok and sm.ident == sequence_ident_frames(seq)
+                if fmt == "json":
+                    # The EmissionGate pin: blake2b over prefix + native
+                    # ident frames == the object path's sequence_identity.
+                    digest_parity = digest_parity and (
+                        hashlib.blake2b(
+                            identity_prefix("stock_rising", k) + sm.ident,
+                            digest_size=16,
+                        ).digest()
+                        == sequence_identity("stock_rising", k, seq)
+                    )
+        parity[fmt] = ok
+        sink_bytes[fmt] = total
+    return dict(
+        events=n_batches * batch * n_keys,
+        matches=n_matches,
+        counts_equal=counts_equal,
+        parity_json=parity["json"],
+        parity_arrow=parity["arrow"],
+        digest_parity=digest_parity,
+        native=load_decoder() is not None,
+        eps={fmt: runs[fmt][1] for fmt in runs},
+        sink_bytes=sink_bytes,
+        controller=controller_state,
+    )
+
+
 def _compile_block(flagship_metrics: Dict[str, Any]) -> Dict[str, Any]:
     """The artifact's `compile` block (ISSUE 9): per-entry-point compile
     telemetry from the flagship engine's registry snapshot -- compile
@@ -1287,6 +1399,18 @@ def main() -> None:
                          matches_per_step=384, nodes_per_step=384),
             (ARGS.keys or (8 if quick else 512)), bb, nb,
         )
+        # Same flagship stock shape with the native JSON sink (ISSUE 17):
+        # the drain's decode stage emits sink bytes directly instead of
+        # Sequence objects, so the eps/e2e delta vs stock_rising_batched
+        # is the decode-stage saving the sink-to-bytes contract claims.
+        log("stock_rising_batched_json (native sink-to-bytes decode)")
+        detail["stock_rising_batched_json"] = bench_device_batched(
+            stock_pattern, stock_schema, stock_stream,
+            EngineConfig(lanes=512, nodes=4096, matches=49152,
+                         matches_per_step=384, nodes_per_step=384),
+            (ARGS.keys or (8 if quick else 512)), bb, nb,
+            sink_format="json",
+        )
         # Latency frontier: small per-drain batches (BASELINE.md names p99
         # match-emit latency a co-equal metric). T=8 with a decode+block
         # every batch trades throughput for a ~two-orders-lower p99 than
@@ -1401,6 +1525,19 @@ def main() -> None:
                 f"/ {tl['wire_mb']:.2f} MB, "
                 f"backpressure {tl['backpressure_hits']:.0f}"
             )
+            # Sink-to-bytes pass (ISSUE 17): objects vs json vs arrow eps
+            # on the same stream, parity + emission-digest equality
+            # pinned in-pass, drain-controller knobs recorded; sources
+            # the artifact's top-level `sink` block.
+            log("sink bytes (objects vs json vs arrow, drain controller)")
+            sk = bench_sink_bytes()
+            detail["sink_pass"] = sk
+            log(
+                f"sink: matches {sk['matches']} native={sk['native']} "
+                f"parity json={sk['parity_json']} arrow={sk['parity_arrow']} "
+                f"digest={sk['digest_parity']} eps "
+                + " ".join(f"{f}={e:.0f}" for f, e in sk["eps"].items())
+            )
         # Config 4: N concurrent queries over one stream.
         log("multi_query (config 4)")
         detail["multi_query"] = bench_multi_query(
@@ -1514,6 +1651,11 @@ def main() -> None:
         # equality + framing overhead over a socket RecordLog; None
         # outside --smoke (the full bench drives engines directly).
         "transport": detail.pop("transport_pass", None),
+        # Sink-to-bytes pass (ISSUE 17): objects vs json vs arrow decode
+        # eps on the same stream with byte/digest parity booleans and the
+        # adaptive drain controller's chosen knobs; None outside --smoke
+        # (the full bench carries stock_rising_batched_json instead).
+        "sink": detail.pop("sink_pass", None),
         "platform": platform,
         "quick": quick,
         # Explicit bench mode (full | quick | smoke): the perf ledger's

@@ -1,6 +1,7 @@
 """Key-axis parallelism: vmapped multi-key engine + mesh sharding."""
 
 from .batched import BatchedDeviceNFA
+from .drain_sched import DrainController
 from .stacked import StackedQueryEngine
 from .key_shard import (
     KEY_AXIS,
@@ -19,6 +20,7 @@ from .key_shard import (
 
 __all__ = [
     "BatchedDeviceNFA",
+    "DrainController",
     "StackedQueryEngine",
     "KEY_AXIS",
     "build_batched_advance",

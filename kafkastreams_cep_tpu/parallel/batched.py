@@ -106,14 +106,40 @@ class BatchedDeviceNFA:
         provenance_sample: float = 0.0,
         provenance_ring: int = 256,
         query_name: Optional[str] = None,
+        sink_format: str = "objects",
     ) -> None:
         if drain_mode not in ("flat", "pool"):
             raise ValueError(f"unknown drain_mode {drain_mode!r}")
+        if sink_format not in ("objects", "json", "arrow"):
+            raise ValueError(f"unknown sink_format {sink_format!r}")
         if isinstance(stages_or_query, CompiledQuery):
             self.query = stages_or_query
         else:
             assert isinstance(stages_or_query, Stages)
             self.query = compile_query(stages_or_query, schema)
+        #: Sink-to-bytes decode (ISSUE 17): "objects" materializes
+        #: Sequence objects (the default); "json"/"arrow" decode the flat
+        #: chain table straight to serialized sink payloads (SinkMatch
+        #: items -- streams/serde.py) with zero Sequence materialization
+        #: on the native path. Bytes modes ride the flat drain table and
+        #: single-query chains only.
+        self.sink_format = sink_format
+        if sink_format != "objects":
+            if drain_mode != "flat":
+                raise ValueError(
+                    "sink_format 'json'/'arrow' requires drain_mode='flat' "
+                    "(the bytes decode walks the chain-flatten table)"
+                )
+            if self.query.qid_of_name_id is not None:
+                raise ValueError(
+                    "sink_format 'json'/'arrow' does not support stacked "
+                    "multi-query engines (qid attribution needs the object "
+                    "path)"
+                )
+            if sink_format == "arrow":
+                from ..streams.serde import arrow_sink_schema
+
+                arrow_sink_schema()  # ImportError without pyarrow
         self.config = config if config is not None else EngineConfig()
         self.mesh = mesh
         self.keys: List[Any] = list(keys)
@@ -459,6 +485,24 @@ class BatchedDeviceNFA:
             "Decoded matches that received a sampled lineage exemplar",
             labels=("query",),
         ).labels(query=self.query_name or "q")
+        sink_matches = r.counter(
+            "cep_sink_matches_total",
+            "Matches decoded straight to sink bytes (sink_format json/arrow)",
+            labels=("query", "format"),
+        )
+        sink_bytes = r.counter(
+            "cep_sink_bytes_total",
+            "Sink payload bytes produced by the sink-to-bytes decode",
+            labels=("query", "format"),
+        )
+        if self.sink_format != "objects":
+            q = self.query_name or "q"
+            self._m_sink_matches = sink_matches.labels(
+                query=q, format=self.sink_format
+            )
+            self._m_sink_bytes = sink_bytes.labels(
+                query=q, format=self.sink_format
+            )
         compute = r.histogram(
             "cep_advance_compute_seconds",
             "Synced compute wall of sampled advances by phase "
@@ -1313,6 +1357,16 @@ class BatchedDeviceNFA:
                     continue
                 self.replays += 1
                 self._m_replays.inc()
+                if matches and self.sink_format != "objects":
+                    # Bytes-mode drains carry SinkMatch items; oracle
+                    # replacements serialize through the host reference
+                    # path (identical bytes by the parity pin).
+                    from ..streams.serde import sink_match_from_sequence
+
+                    matches = [
+                        sink_match_from_sequence(s, self.sink_format)
+                        for s in matches
+                    ]
                 if matches:
                     out[key] = matches
                 else:
@@ -1956,6 +2010,10 @@ class BatchedDeviceNFA:
         the ring is a deque (atomic appends) snapshotted by readers."""
         if self.provenance_sample <= 0.0 or not decoded:
             return
+        if self.sink_format != "objects":
+            # Bytes decode samples inline (_sample_bytes_provenance): the
+            # stride accumulator already advanced per match there.
+            return
         from ..ops.runtime import sequence_provenance
 
         names = self.query.query_names
@@ -2094,6 +2152,10 @@ class BatchedDeviceNFA:
         gidx = np.moveaxis(table[0], -1, 0)
         name = np.moveaxis(table[1], -1, 0)
         live = np.moveaxis(table[2], -1, 0)
+        if self.sink_format != "objects":
+            out = self._decode_flat_bytes(raw, counts, gidx, name, live, events)
+            raw["decode_s"] = _time.perf_counter() - t_land
+            return out
         qid_tab = self.query.qid_of_name_id
         native = self._native_decoder()
         if native is not None and hasattr(native, "decode_matches_flat"):
@@ -2117,6 +2179,21 @@ class BatchedDeviceNFA:
             }
             raw["decode_s"] = _time.perf_counter() - t_land
             return out
+        out = self._decode_flat_python(counts, gidx, name, live, events)
+        raw["decode_s"] = _time.perf_counter() - t_land
+        return out
+
+    def _decode_flat_python(
+        self,
+        counts: np.ndarray,
+        gidx: np.ndarray,
+        name: np.ndarray,
+        live: np.ndarray,
+        events: Dict[int, Event],
+    ) -> Dict[Any, List[Sequence]]:
+        """The numpy + Python fallback walk over the flat table (semantic
+        reference for decode_matches_flat)."""
+        qid_tab = self.query.qid_of_name_id
         K, Mb, Cb = gidx.shape
         out: Dict[Any, List[Sequence]] = {}
         for k in range(min(K, len(self.keys))):
@@ -2143,8 +2220,138 @@ class BatchedDeviceNFA:
                     seqs.append(seq)
             if seqs:
                 out[self.keys[k]] = seqs
-        raw["decode_s"] = _time.perf_counter() - t_land
         return out
+
+    def _decode_flat_bytes(
+        self,
+        raw: Dict[str, Any],
+        counts: np.ndarray,
+        gidx: np.ndarray,
+        name: np.ndarray,
+        live: np.ndarray,
+        events: Dict[int, Event],
+    ) -> Dict[Any, List[Any]]:
+        """Sink-to-bytes decode of the flat table (ISSUE 17): matches
+        serialize straight to SinkMatch items -- JSON payloads or Arrow
+        column buffers from native/decoder.cc with zero Sequence
+        materialization -- byte-identical to serializing the object
+        path's Sequences (the golden parity pin). Falls back to object
+        decode + host serialization without the native extension.
+        Provenance-sampled matches re-decode through the object path."""
+        from ..core.sequence import Staged
+        from ..streams.serde import (
+            SinkMatch,
+            arrow_ipc_from_columns,
+            json_fragment,
+            sink_match_from_sequence,
+        )
+
+        fmt = self.sink_format
+        native = self._native_decoder()
+        out: Dict[Any, List[Any]] = {}
+        n_matches = 0
+        payload_bytes = 0
+        if native is not None and hasattr(native, "decode_matches_json"):
+            fn = (
+                native.decode_matches_json
+                if fmt == "json"
+                else native.decode_matches_arrow
+            )
+            per_key = fn(
+                counts, gidx, name, live, self.query.name_of_id, events,
+                Staged, Sequence, json_fragment,
+            )
+            for k, items in enumerate(per_key):
+                if not items or k >= len(self.keys):
+                    continue
+                sms: List[SinkMatch] = []
+                for item in items:
+                    if fmt == "json":
+                        payload, ident, last = item
+                    else:
+                        so, sd, vo, vd, rows, ident, last = item
+                        payload = arrow_ipc_from_columns(so, sd, vo, vd, rows)
+                    sms.append(SinkMatch(fmt, payload, ident, last))
+                    payload_bytes += len(payload)
+                out[self.keys[k]] = sms
+                n_matches += len(sms)
+        else:
+            for key, seqs in self._decode_flat_python(
+                counts, gidx, name, live, events
+            ).items():
+                sms = [sink_match_from_sequence(s, fmt) for s in seqs]
+                payload_bytes += sum(len(s.payload) for s in sms)
+                n_matches += len(sms)
+                out[key] = sms
+        if n_matches:
+            self._m_sink_matches.inc(n_matches)
+            self._m_sink_bytes.inc(payload_bytes)
+        if self.provenance_sample > 0.0 and n_matches:
+            self._sample_bytes_provenance(
+                raw, counts, gidx, name, live, events, out
+            )
+        return out
+
+    def _sample_bytes_provenance(
+        self,
+        raw: Dict[str, Any],
+        counts: np.ndarray,
+        gidx: np.ndarray,
+        name: np.ndarray,
+        live: np.ndarray,
+        events: Dict[int, Event],
+        out: Dict[Any, List[Any]],
+    ) -> None:
+        """Provenance sampling for the bytes decode: the stride
+        accumulator advances per match exactly as the object path does,
+        and each sampled SinkMatch re-decodes its chain through
+        materialize_sequence (the object path) for the lineage exemplar
+        -- attached as `.sequence` and recorded in the ring."""
+        from ..ops.runtime import sequence_provenance
+
+        qname = self.query_name or "q"
+        trigger = raw.get("trigger", "drain")
+        Mb, Cb = gidx.shape[1], gidx.shape[2]
+        for k in range(min(gidx.shape[0], len(self.keys))):
+            sms = out.get(self.keys[k])
+            if not sms:
+                continue
+            want: Dict[int, Any] = {}
+            for pos in range(len(sms)):
+                self._prov_acc += self.provenance_sample
+                if self._prov_acc >= 1.0:
+                    self._prov_acc -= 1.0
+                    want[pos] = sms[pos]
+            if not want:
+                continue
+            pos = 0
+            n = min(int(counts[k]), Mb)
+            for j in range(n):
+                chain: List[Tuple[int, int]] = []
+                for c in range(Cb):
+                    if not live[k, j, c]:
+                        break
+                    g = int(gidx[k, j, c])
+                    if g >= 0:
+                        chain.append((int(name[k, j, c]), g))
+                if not chain:
+                    continue
+                sm = want.get(pos)
+                pos += 1
+                if sm is None:
+                    continue
+                chain.reverse()
+                seq = materialize_sequence(
+                    chain, self.query.name_of_id, events
+                )
+                prov = sequence_provenance(
+                    seq, query=qname, trigger=trigger
+                )
+                seq.provenance = prov
+                sm.sequence = seq
+                with self._prov_lock:
+                    self._prov_ring.append((self.keys[k], prov))
+                self._m_prov.inc()
 
     def _prune_events(self) -> None:
         """Bound the host event registry: keep pool-referenced events plus

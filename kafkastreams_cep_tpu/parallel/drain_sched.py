@@ -1,0 +1,270 @@
+"""Adaptive drain scheduler (ISSUE 17): closed-loop cadence control.
+
+The drain cadence knobs -- `target_emit_ms` (micro-drain dial),
+`gc_group` (GC fold cadence) and the caller's batch extent `T` -- were
+static bench knobs tuned per workload by hand (BENCH rounds r05-r07).
+This module replaces them with a per-engine controller fed by signals
+the observability plane already publishes with zero extra syncs:
+
+  * the live `cep_match_latency_seconds{query}` histogram (ingest ->
+    sink emission wall, streams/builder.py) -- the p99 the ROADMAP
+    contract is written against;
+  * the fused `[3, K]` probe's pend-ring occupancy and node-region fill
+    (`BatchedDeviceNFA._occupancy_bound()` -- async probes, never a
+    device sync);
+  * the sampled `profile_every` compute walls
+    (`cep_advance_compute_seconds{instance, phase}`, ISSUE 9/PR 8).
+
+Control law, deliberately boring (AIMD with hysteresis):
+
+  * `target_emit_ms` is a pure host knob (no recompile): multiplicative
+    decrease whenever observed p99 overshoots the target or the pend
+    ring runs hot, slow multiplicative increase back toward the relaxed
+    ceiling when there is latency headroom AND the ring is cool --
+    fewer forced syncs on quiet streams, tight cadence under load.
+  * `gc_group` moves in power-of-two steps (halve when the node region
+    runs hot -- fold more often so the region stays compact; double when
+    the region is cool and the sampled post wall dominates the advance
+    wall -- amortize the fold). Every change retraces the drain-side
+    concatenation shapes, so changes are BUDGETED: at most
+    `compile_budget` over the controller's lifetime, each preceded by an
+    explicit `engine._flush_group()` (node ids are only region-stable
+    through the flush), with a cooldown between steps. Budget exhausted
+    == knob frozen == steady state is compile-flat (the jit_audit pin;
+    CompileWatch counts stay the loud backstop).
+  * `T` is advisory (`suggest_t()`): sized so one packed advance covers
+    about half the emit budget at the observed ingest rate -- callers
+    that own their batching (bench drivers, faults soak) read it per
+    iteration; the engine never resizes itself.
+
+The controller exposes `cep_drain_controller_*` gauges so the chosen
+knobs are first-class telemetry (the soak/bench artifacts record
+`state()` directly).
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Any, Dict, Optional
+
+__all__ = ["DrainController"]
+
+
+def _pow2_down(n: int) -> int:
+    return max(1, n // 2)
+
+
+def _pow2_up(n: int) -> int:
+    return max(2, n * 2)
+
+
+class DrainController:
+    """Closed-loop drain cadence for one `BatchedDeviceNFA`.
+
+    Call `observe(events=N)` once per drive iteration (after the advance
+    or drain); the controller re-reads its signals, moves the knobs, and
+    returns the current `state()`. All reads are host-side -- the
+    controller never syncs the device.
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        *,
+        target_p99_ms: float = 500.0,
+        min_emit_ms: float = 2.0,
+        max_emit_ms: float = 1000.0,
+        compile_budget: int = 6,
+        gc_group_min: int = 1,
+        gc_group_max: int = 64,
+        cooldown: int = 16,
+        t_min: int = 8,
+        t_max: int = 8192,
+        registry: Optional[Any] = None,
+    ) -> None:
+        if target_p99_ms <= 0:
+            raise ValueError(f"target_p99_ms must be > 0, got {target_p99_ms}")
+        if not 0 < min_emit_ms <= max_emit_ms:
+            raise ValueError(
+                f"need 0 < min_emit_ms <= max_emit_ms, got "
+                f"({min_emit_ms}, {max_emit_ms})"
+            )
+        self.engine = engine
+        self.query = getattr(engine, "query_name", None) or "q"
+        self.target_p99_ms = float(target_p99_ms)
+        self.min_emit_ms = float(min_emit_ms)
+        self.max_emit_ms = float(max_emit_ms)
+        self.compile_budget = int(compile_budget)
+        self.gc_group_min = max(1, int(gc_group_min))
+        self.gc_group_max = max(self.gc_group_min, int(gc_group_max))
+        self.cooldown = max(1, int(cooldown))
+        self.t_min = max(1, int(t_min))
+        self.t_max = max(self.t_min, int(t_max))
+        self.metrics = registry if registry is not None else engine.metrics
+        # Arm the micro-drain dial if the engine ran without one: the
+        # controller owns this knob from here on.
+        if engine.target_emit_ms is None:
+            engine.target_emit_ms = self.max_emit_ms
+        self._adjustments = 0
+        self._gc_changes = 0
+        self._ticks = 0
+        self._last_gc_tick = -self.cooldown
+        self._last_p99_ms: Optional[float] = None
+        self._rate_t = _time.perf_counter()
+        self._rate_ev_s = 0.0  # EWMA of the observed ingest rate
+        lab = dict(query=self.query)
+        self._m_emit = self.metrics.gauge(
+            "cep_drain_controller_target_emit_ms",
+            "Micro-drain emit budget chosen by the adaptive drain "
+            "controller",
+            labels=("query",),
+        ).labels(**lab)
+        self._m_gc = self.metrics.gauge(
+            "cep_drain_controller_gc_group",
+            "GC fold cadence chosen by the adaptive drain controller",
+            labels=("query",),
+        ).labels(**lab)
+        self._m_p99 = self.metrics.gauge(
+            "cep_drain_controller_p99_ms",
+            "Freshest match-latency p99 the drain controller acted on",
+            labels=("query",),
+        ).labels(**lab)
+        self._m_occ = self.metrics.gauge(
+            "cep_drain_controller_occupancy_ratio",
+            "Pend-ring occupancy fraction the drain controller acted on",
+            labels=("query",),
+        ).labels(**lab)
+        self._m_adjust = self.metrics.counter(
+            "cep_drain_controller_adjustments_total",
+            "Knob moves by the adaptive drain controller",
+            labels=("query", "knob"),
+        )
+        self._m_emit.set(float(engine.target_emit_ms))
+        self._m_gc.set(float(engine.gc_group))
+
+    # -------------------------------------------------------------- signals
+    def _p99_ms(self) -> Optional[float]:
+        """Freshest p99 (ms) from the live match-latency histogram; None
+        before the emission path has observed anything."""
+        fam = self.metrics.get("cep_match_latency_seconds")
+        if fam is None:
+            return None
+        try:
+            p = fam.labels(query=self.query).percentile(99)
+        except (ValueError, TypeError):
+            return None
+        return None if p is None else p * 1e3
+
+    def _occupancy(self) -> tuple:
+        """(ring occupancy fraction, region fill fraction) from the async
+        probe bound -- both upper bounds, never a sync."""
+        occ, fill, _pos = self.engine._occupancy_bound()
+        ring = max(1, int(self.engine.config.matches))
+        nodes = max(1, int(self.engine.config.nodes))
+        return min(1.0, occ / ring), min(1.0, fill / nodes)
+
+    def _post_dominates(self) -> bool:
+        """True when the sampled GC/fold (post) wall exceeds the advance
+        wall -- the amortization signal for doubling gc_group. False with
+        no samples (profiling off)."""
+        fam = self.metrics.get("cep_advance_compute_seconds")
+        if fam is None:
+            return False
+        inst = getattr(self.engine, "instance_id", None)
+        if inst is None:
+            return False
+        try:
+            adv = fam.labels(instance=inst, phase="advance").mean()
+            post = fam.labels(instance=inst, phase="post").mean()
+        except (ValueError, TypeError):
+            return False
+        return adv is not None and post is not None and post > adv
+
+    # -------------------------------------------------------------- control
+    def observe(self, events: int = 0) -> Dict[str, Any]:
+        """One control tick: fold `events` into the rate estimate, re-read
+        the signals, move the knobs. Returns `state()`."""
+        self._ticks += 1
+        now = _time.perf_counter()
+        dt = now - self._rate_t
+        if events > 0 and dt > 0:
+            inst = events / dt
+            self._rate_ev_s = (
+                inst if self._rate_ev_s == 0.0
+                else 0.8 * self._rate_ev_s + 0.2 * inst
+            )
+        self._rate_t = now
+
+        p99 = self._p99_ms()
+        occ, fill = self._occupancy()
+        self._last_p99_ms = p99
+        if p99 is not None:
+            self._m_p99.set(p99)
+        self._m_occ.set(occ)
+
+        self._tune_emit(p99, occ)
+        self._tune_gc_group(fill)
+        return self.state()
+
+    def _tune_emit(self, p99: Optional[float], occ: float) -> None:
+        cur = float(self.engine.target_emit_ms)
+        new = cur
+        if (p99 is not None and p99 > self.target_p99_ms) or occ > 0.5:
+            new = max(self.min_emit_ms, cur * 0.5)
+        elif occ < 0.1 and (p99 is None or p99 < self.target_p99_ms * 0.5):
+            new = min(self.max_emit_ms, cur * 1.25)
+        if new != cur:
+            self.engine.target_emit_ms = new
+            self._adjustments += 1
+            self._m_adjust.labels(query=self.query, knob="target_emit_ms").inc()
+            self._m_emit.set(new)
+
+    def _tune_gc_group(self, fill: float) -> None:
+        if self._gc_changes >= self.compile_budget:
+            return  # budget spent: knob frozen, steady state compile-flat
+        if self._ticks - self._last_gc_tick < self.cooldown:
+            return  # hysteresis between retrace-risking steps
+        cur = int(self.engine.gc_group)
+        new = cur
+        if fill > 0.75 and cur > self.gc_group_min:
+            new = _pow2_down(cur)
+        elif fill < 0.25 and cur < self.gc_group_max and self._post_dominates():
+            new = min(self.gc_group_max, _pow2_up(cur))
+        if new == cur:
+            return
+        # Node ids are only region-stable through the fold: flush the
+        # accumulated window under the OLD cadence before changing it
+        # (also keeps the G vs G=1 bitwise contract intact).
+        self.engine._flush_group()
+        self.engine.gc_group = new
+        self._gc_changes += 1
+        self._last_gc_tick = self._ticks
+        self._adjustments += 1
+        self._m_adjust.labels(query=self.query, knob="gc_group").inc()
+        self._m_gc.set(float(new))
+
+    def suggest_t(self) -> int:
+        """Advisory packed-batch extent: cover about half the emit budget
+        per advance at the observed ingest rate (so the micro-drain dial
+        keeps firing between advances), clamped to [t_min, t_max]."""
+        if self._rate_ev_s <= 0:
+            return self.t_min
+        per_key = self._rate_ev_s / max(1, len(self.engine.keys))
+        t = int(per_key * (float(self.engine.target_emit_ms) / 2e3))
+        return max(self.t_min, min(self.t_max, t))
+
+    def state(self) -> Dict[str, Any]:
+        """The chosen knobs + freshest signals, JSON-ready (recorded into
+        the bench `sink` block and the soak scenario artifacts)."""
+        cw = getattr(self.engine, "compile_watch", None)
+        return {
+            "target_emit_ms": float(self.engine.target_emit_ms),
+            "gc_group": int(self.engine.gc_group),
+            "suggest_t": self.suggest_t(),
+            "p99_ms": self._last_p99_ms,
+            "rate_ev_s": self._rate_ev_s,
+            "ticks": self._ticks,
+            "adjustments": self._adjustments,
+            "gc_changes": self._gc_changes,
+            "compile_budget": self.compile_budget,
+            "compiles_seen": None if cw is None else cw.seen_count,
+        }

@@ -501,12 +501,24 @@ class Topology:
         """Route device-processor [(key, Sequence)] results downstream.
 
         Record metadata comes from the match's completing (last) event so
-        host- and device-runtime outputs carry equivalent context."""
+        host- and device-runtime outputs carry equivalent context.
+
+        Bytes-mode engines (sink_format json/arrow) yield SinkMatch items
+        instead of Sequences: admission digests over the native ident
+        frames (`admit_ident` -- bitwise-equal to `admit` on the same
+        match), Record metadata from the carried completing event, and
+        the sink write reuses the pre-serialized payload."""
+        from .serde import SinkMatch
+
         emitted: List[Record] = []
         for rkey, seq in results:
             # Dedup gates the durable sink only -- see Topology.process.
-            digest = node.gate.admit(rkey, seq)
-            last = seq.matched[-1].events[-1] if seq.matched else None
+            if isinstance(seq, SinkMatch):
+                digest = node.gate.admit_ident(rkey, seq.ident)
+                last = seq.last_event
+            else:
+                digest = node.gate.admit(rkey, seq)
+                last = seq.matched[-1].events[-1] if seq.matched else None
             record = Record(
                 rkey,
                 seq,
@@ -539,10 +551,16 @@ class Topology:
         the tail and dedupes with no cross-topic atomicity."""
         if self.log is None or not node.sink_topics:
             return
-        from .serde import sequence_to_json
+        from .serde import SinkMatch, sequence_to_json
 
         key_bytes = encode_sink_key(record.key, digest)
-        value_bytes = sequence_to_json(record.value).encode("utf-8")
+        if isinstance(record.value, SinkMatch):
+            # Sink-to-bytes decode: the payload was serialized natively
+            # off the chain table -- byte-identical to the line below on
+            # the same match (the golden parity pin).
+            value_bytes = record.value.payload
+        else:
+            value_bytes = sequence_to_json(record.value).encode("utf-8")
         for topic in node.sink_topics:
             self.log.append(
                 topic, key_bytes, value_bytes, timestamp=record.timestamp

@@ -38,6 +38,12 @@ class DeviceCEPProcessor(Generic[K, V]):
     `process()` enqueues and auto-flushes once `batch_size` records are
     pending; `flush()` forces the pending micro-batch through the engine and
     returns [(key, Sequence)] in per-key emission order.
+
+    Sink-to-bytes mode rides `**engine_opts`: pass
+    `sink_format="json"|"arrow"` and every flush yields `(key, SinkMatch)`
+    pairs instead -- matches serialized straight off the device chain
+    table (parallel/batched.py `_decode_flat_bytes`), which the topology's
+    `_emit_device` admits by ident frames and sinks without re-encoding.
     """
 
     def __init__(

@@ -41,6 +41,43 @@ from ..state.nfa_store import EmissionStore, EmitWatermark
 SINK_KEY_TAG = "kct-sink-v1"
 
 
+def _put(out: bytearray, data: bytes) -> None:
+    out += struct.pack("<I", len(data))
+    out += data
+
+
+def identity_prefix(query: str, key: Any) -> bytes:
+    """The (query, canonical key) frames that open every sequence
+    identity. The user key -- an arbitrary object -- is canonicalized
+    through one serialize/deserialize round trip (see
+    `sequence_identity`)."""
+    out = bytearray()
+    _put(out, query.encode("utf-8"))
+    key_bytes = pickle.dumps(key, protocol=pickle.HIGHEST_PROTOCOL)
+    _put(
+        out,
+        pickle.dumps(
+            pickle.loads(key_bytes), protocol=pickle.HIGHEST_PROTOCOL
+        ),
+    )
+    return bytes(out)
+
+
+def sequence_ident_frames(seq: Sequence) -> bytes:
+    """The per-stage identity frame suffix of `sequence_identity`: what
+    the native sink-to-bytes decoder (decoder.cc emit_bytes) emits as
+    `ident`, byte-for-byte -- `EmissionGate.admit_ident` hashes
+    `identity_prefix + frames` and must equal `admit`'s digest."""
+    out = bytearray()
+    for staged in seq.matched:
+        _put(out, b"\x01")
+        _put(out, staged.stage.encode("utf-8"))
+        for e in staged.events:
+            _put(out, e.topic.encode("utf-8"))
+            out += struct.pack("<qq", int(e.partition), int(e.offset))
+    return bytes(out)
+
+
 def sequence_identity(query: str, key: Any, seq: Sequence) -> bytes:
     """Canonical identity bytes of one match: query, record key, and the
     per-stage matched event identities ((topic, partition, offset) -- the
@@ -54,24 +91,8 @@ def sequence_identity(query: str, key: Any, seq: Sequence) -> bytes:
     is canonicalized through one serialize/deserialize round trip for the
     same reason."""
     h = hashlib.blake2b(digest_size=16)
-
-    def put(data: bytes) -> None:
-        h.update(struct.pack("<I", len(data)))
-        h.update(data)
-
-    put(query.encode("utf-8"))
-    key_bytes = pickle.dumps(key, protocol=pickle.HIGHEST_PROTOCOL)
-    put(
-        pickle.dumps(
-            pickle.loads(key_bytes), protocol=pickle.HIGHEST_PROTOCOL
-        )
-    )
-    for staged in seq.matched:
-        put(b"\x01")
-        put(staged.stage.encode("utf-8"))
-        for e in staged.events:
-            put(e.topic.encode("utf-8"))
-            h.update(struct.pack("<qq", int(e.partition), int(e.offset)))
+    h.update(identity_prefix(query, key))
+    h.update(sequence_ident_frames(seq))
     return h.digest()
 
 
@@ -141,10 +162,40 @@ class EmissionGate:
         #: the fault-free path NEVER drops a real duplicate; regeneration
         #: during replay renumbers identically (deterministic order).
         self._occurrence: Dict[bytes, int] = {}
+        #: per-key identity_prefix cache for the bytes path: the prefix
+        #: pickles the key twice per match otherwise. Bounded; cleared
+        #: wholesale on overflow (keys are usually few and stable).
+        self._prefix_cache: Dict[Any, bytes] = {}
 
     # ------------------------------------------------------------- admission
     def admit(self, key: Any, seq: Sequence) -> Optional[bytes]:
-        base = sequence_identity(self.query, key, seq)
+        return self._qualify(sequence_identity(self.query, key, seq))
+
+    def admit_ident(self, key: Any, ident: bytes) -> Optional[bytes]:
+        """Bytes-path admission: `ident` is the per-stage identity frame
+        suffix the native sink-to-bytes decoder emitted
+        (`sequence_ident_frames`). The digest is bitwise-identical to
+        `admit(key, seq)` on the same match -- the exactly-once window is
+        shared across object- and bytes-mode emissions."""
+        h = hashlib.blake2b(digest_size=16)
+        h.update(self._key_prefix(key))
+        h.update(ident)
+        return self._qualify(h.digest())
+
+    def _key_prefix(self, key: Any) -> bytes:
+        try:
+            cached = self._prefix_cache.get(key)
+        except TypeError:  # unhashable key: compute every time
+            return identity_prefix(self.query, key)
+        if cached is None:
+            if len(self._prefix_cache) >= 4096:
+                self._prefix_cache.clear()
+            cached = self._prefix_cache[key] = identity_prefix(
+                self.query, key
+            )
+        return cached
+
+    def _qualify(self, base: bytes) -> Optional[bytes]:
         n = self._occurrence.get(base, 0)
         self._occurrence[base] = n + 1
         digest = hashlib.blake2b(

@@ -41,6 +41,152 @@ def sequence_to_json(sequence: Sequence) -> str:
     return json.dumps(sequence_to_dict(sequence), separators=(",", ":"))
 
 
+# --------------------------------------------------------------- sink bytes
+# The sink-to-bytes decode path (ISSUE 17): when the consumer is a
+# serializing sink, the native decoder (native/decoder.cc
+# decode_matches_json / decode_matches_arrow) walks the chain-flatten
+# table straight into these byte shapes with zero Sequence
+# materialization. Everything below is the host-Python REFERENCE for
+# those bytes -- the golden parity suite pins the native output
+# byte-equal to these functions applied to the decoded objects.
+
+#: Arrow sink column names: one row per matched event, exploded in
+#: Sequence.matched order. `value` holds the compact JSON fragment of
+#: `_event_value_repr(e.value)` so arbitrary value types stay exact.
+ARROW_SINK_COLUMNS = ("stage", "value")
+
+
+def json_fragment(value: Any) -> str:
+    """Compact JSON of one value -- the encoding `sequence_to_json` uses
+    per event, and the native decoder's fallback for exotic value types
+    (it calls back into this for anything beyond None/bool/int/float/str
+    so composition stays byte-identical)."""
+    return json.dumps(value, separators=(",", ":"))
+
+
+def sequence_to_json_bytes(sequence: Sequence) -> bytes:
+    """Reference JSON sink payload: what decode_matches_json emits."""
+    return sequence_to_json(sequence).encode("utf-8")
+
+
+def _arrow():
+    try:
+        import pyarrow as pa
+    except ImportError as e:  # pragma: no cover - pyarrow baked into image
+        raise ImportError(
+            "sink_format='arrow' requires pyarrow (not installed)"
+        ) from e
+    return pa
+
+
+def arrow_sink_schema():
+    """The per-match Arrow sink schema (stage: utf8, value: utf8)."""
+    pa = _arrow()
+    return pa.schema([(c, pa.utf8()) for c in ARROW_SINK_COLUMNS])
+
+
+def _arrow_ipc(stage_arr, value_arr) -> bytes:
+    pa = _arrow()
+    batch = pa.record_batch(
+        [stage_arr, value_arr], schema=arrow_sink_schema()
+    )
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, batch.schema) as w:
+        w.write_batch(batch)
+    return sink.getvalue().to_pybytes()
+
+
+def sequence_to_arrow_ipc(sequence: Sequence) -> bytes:
+    """Reference Arrow sink payload: one IPC stream holding one record
+    batch, one row per matched event (what the wrapped
+    decode_matches_arrow buffers serialize to)."""
+    pa = _arrow()
+    stages = [st.stage for st in sequence.matched for _ in st.events]
+    values = [
+        json_fragment(_event_value_repr(e.value))
+        for st in sequence.matched
+        for e in st.events
+    ]
+    return _arrow_ipc(
+        pa.array(stages, pa.utf8()), pa.array(values, pa.utf8())
+    )
+
+
+def arrow_ipc_from_columns(
+    stage_off: bytes,
+    stage_data: bytes,
+    value_off: bytes,
+    value_data: bytes,
+    rows: int,
+) -> bytes:
+    """Zero-copy wrap of the native decoder's raw column buffers (int32
+    offsets + utf8 data per string column) into the same IPC stream
+    `sequence_to_arrow_ipc` produces."""
+    pa = _arrow()
+    stage = pa.Array.from_buffers(
+        pa.utf8(), rows,
+        [None, pa.py_buffer(stage_off), pa.py_buffer(stage_data)],
+    )
+    value = pa.Array.from_buffers(
+        pa.utf8(), rows,
+        [None, pa.py_buffer(value_off), pa.py_buffer(value_data)],
+    )
+    return _arrow_ipc(stage, value)
+
+
+class SinkMatch:
+    """One decoded match already serialized to sink bytes.
+
+    The bytes-mode decode worker emits these instead of `Sequence`
+    objects: `payload` is the sink record value (JSON text or an Arrow
+    IPC stream), `ident` the per-stage identity frames the EmissionGate
+    digests (`admit_ident` -- digest parity with `admit(key, seq)` is
+    the correctness pin), `last_event` the completing event carrying the
+    Record timestamp/topic/partition/offset. `sequence` is only
+    populated for provenance-sampled matches, which re-decode through
+    the object path."""
+
+    __slots__ = ("format", "payload", "ident", "last_event", "sequence")
+
+    def __init__(
+        self,
+        format: str,
+        payload: bytes,
+        ident: bytes,
+        last_event: Any,
+        sequence: Optional[Sequence] = None,
+    ) -> None:
+        self.format = format
+        self.payload = payload
+        self.ident = ident
+        self.last_event = last_event
+        self.sequence = sequence
+
+    def __repr__(self) -> str:
+        return (
+            f"SinkMatch(format={self.format!r}, "
+            f"payload={len(self.payload)}B, last={self.last_event!r})"
+        )
+
+
+def sink_match_from_sequence(sequence: Sequence, format: str) -> SinkMatch:
+    """Host-Python fallback (and semantic reference) for the native
+    sink-to-bytes decode: serialize an already-materialized Sequence into
+    the same SinkMatch the native path emits."""
+    from .emission import sequence_ident_frames
+
+    if format == "json":
+        payload = sequence_to_json_bytes(sequence)
+    elif format == "arrow":
+        payload = sequence_to_arrow_ipc(sequence)
+    else:
+        raise ValueError(f"unknown sink format {format!r}")
+    last = sequence.matched[-1].events[-1] if sequence.matched else None
+    return SinkMatch(
+        format, payload, sequence_ident_frames(sequence), last, sequence
+    )
+
+
 class Queried:
     """Key/value schema holder for a deployed query (Queried.java:26-88).
 

@@ -62,6 +62,10 @@ TOP_LEVEL: Dict[str, Tuple[bool, tuple]] = {
     "metrics_merged": (True, (dict, type(None))),
     "watermark": (True, (dict, type(None))),
     "transport": (True, (dict, type(None))),
+    # Sink-to-bytes pass (ISSUE 17): objects vs json vs arrow decode eps
+    # with parity booleans and the drain controller's chosen knobs; None
+    # outside --smoke.
+    "sink": (True, (dict, type(None))),
     "compile": (True, (dict,)),
     "regression": (True, (dict, type(None))),
     "schema_ok": (False, (bool,)),
@@ -104,6 +108,48 @@ TRANSPORT_KEYS: Dict[str, tuple] = {
     "reconnects": NUMBER,
     "retries": NUMBER,
     "torn_frames": NUMBER,
+}
+
+#: The `sink` block (ISSUE 17): the smoke's sink-to-bytes pass -- the
+#: same stock stream through objects/json/arrow engines, byte + emission-
+#: digest parity pinned against the object path, decode-path eps per
+#: format, and the adaptive drain controller's chosen knobs.
+SINK_KEYS: Dict[str, tuple] = {
+    "events": NUMBER,
+    "matches": NUMBER,
+    "counts_equal": (bool,),
+    "parity_json": (bool,),
+    "parity_arrow": (bool,),
+    "digest_parity": (bool,),
+    "native": (bool,),
+    "eps": (dict,),
+    "sink_bytes": (dict,),
+    "controller": (dict,),
+}
+SINK_EPS_KEYS: Dict[str, tuple] = {
+    "objects": NUMBER,
+    "json": NUMBER,
+    "arrow": NUMBER,
+}
+SINK_BYTES_KEYS: Dict[str, tuple] = {
+    "json": NUMBER,
+    "arrow": NUMBER,
+}
+#: DrainController.state() (parallel/drain_sched.py): the knob/signal
+#: snapshot embedded by both the bench `sink` block and the soak's
+#: auto-cadence scenario; pinned both ways here AND consumed by
+#: scripts/perf_ledger.py (SINK_CONTROLLER_KEYS there must match).
+SINK_CONTROLLER_KEYS: Dict[str, tuple] = {
+    "target_emit_ms": NUMBER,
+    "gc_group": NUMBER,
+    "suggest_t": NUMBER,
+    "p99_ms": OPT_NUMBER,
+    "rate_ev_s": NUMBER,
+    "ticks": NUMBER,
+    "adjustments": NUMBER,
+    "gc_changes": NUMBER,
+    "compile_budget": NUMBER,
+    "compiles_seen": OPT_NUMBER,
 }
 
 #: The `observation` block (ISSUE 7): what telemetry was armed while the
@@ -293,7 +339,10 @@ SOAK_SERIES_KEYS: Dict[str, tuple] = {
     "slope_per_s": NUMBER,
 }
 
-#: One scenario detail entry.
+#: One scenario detail entry. `controller` carries the adaptive drain
+#: controller's chosen knobs (DrainController.state()) for the
+#: auto-cadence scenario (ISSUE 17); None for scenarios running without
+#: the controller.
 SOAK_SCENARIO_KEYS: Dict[str, tuple] = {
     "generator": (str,),
     "runtime": (str,),
@@ -302,6 +351,7 @@ SOAK_SCENARIO_KEYS: Dict[str, tuple] = {
     "matches": NUMBER,
     "eps": NUMBER,
     "gated": (bool,),
+    "controller": (dict, type(None)),
 }
 
 
@@ -370,6 +420,11 @@ def validate_soak(out: Any) -> List[str]:
                 _check_flat_block(
                     sc, SOAK_SCENARIO_KEYS, f"scenarios.{name}", errors
                 )
+                if isinstance(sc.get("controller"), dict):
+                    _check_flat_block(
+                        sc["controller"], SINK_CONTROLLER_KEYS,
+                        f"scenarios.{name}.controller", errors,
+                    )
     if isinstance(out.get("metrics"), dict):
         _check_metrics_section(out["metrics"], errors)
     faults = out.get("faults")
@@ -562,6 +617,20 @@ def validate(out: Any) -> List[str]:
         _check_flat_block(
             out.get("transport"), TRANSPORT_KEYS, "transport", errors
         )
+    sink = out.get("sink")
+    if isinstance(sink, dict):
+        _check_flat_block(sink, SINK_KEYS, "sink", errors)
+        if isinstance(sink.get("eps"), dict):
+            _check_flat_block(sink["eps"], SINK_EPS_KEYS, "sink.eps", errors)
+        if isinstance(sink.get("sink_bytes"), dict):
+            _check_flat_block(
+                sink["sink_bytes"], SINK_BYTES_KEYS, "sink.sink_bytes", errors
+            )
+        if isinstance(sink.get("controller"), dict):
+            _check_flat_block(
+                sink["controller"], SINK_CONTROLLER_KEYS, "sink.controller",
+                errors,
+            )
     compile_block = out.get("compile")
     if isinstance(compile_block, dict):
         _check_flat_block(compile_block, COMPILE_KEYS, "compile", errors)

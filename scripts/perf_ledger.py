@@ -80,12 +80,64 @@ KNOWN_CONFIGS = {
     "skip_any8_batched",
     "highcard_letters_batched",
     "stock_rising_batched",
+    "stock_rising_batched_json",
     "skip_any8_latency",
     "skip_any8_latency_microdrain",
     "multi_query",
     "introspection",
+    # Per-format pseudo-configs folded out of the `sink` block (ISSUE
+    # 17) at ingestion -- _sink_configs synthesizes them so the eps
+    # trajectory/regression machinery tracks sink decode paths too.
+    "sink_bytes_objects",
+    "sink_bytes_json",
+    "sink_bytes_arrow",
 }
 KNOWN_CONFIG_RE = re.compile(r"_(batched|latency|query)\w*$")
+
+#: DrainController.state() key set (parallel/drain_sched.py), pinned
+#: both ways: a controller snapshot in a `sink` block that grows or
+#: loses keys is reported as drift in the round notes. Must match
+#: check_bench_schema.py SINK_CONTROLLER_KEYS.
+SINK_CONTROLLER_KEYS = (
+    "target_emit_ms",
+    "gc_group",
+    "suggest_t",
+    "p99_ms",
+    "rate_ev_s",
+    "ticks",
+    "adjustments",
+    "gc_changes",
+    "compile_budget",
+    "compiles_seen",
+)
+
+
+def _sink_configs(
+    doc: Any,
+) -> Tuple[Dict[str, Any], Optional[Dict[str, Any]], List[str]]:
+    """Fold an artifact's top-level `sink` block (ISSUE 17) into
+    per-format pseudo-configs ({"sink_bytes_json": {"eps": ...}, ...})
+    so the trajectory and regression checks track sink decode eps like
+    any other config. Returns (pseudo_configs, controller_state,
+    controller_key_drift)."""
+    sink = doc.get("sink") if isinstance(doc, dict) else None
+    if not isinstance(sink, dict):
+        return {}, None, []
+    configs: Dict[str, Any] = {}
+    eps = sink.get("eps")
+    if isinstance(eps, dict):
+        for fmt, v in eps.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                configs[f"sink_bytes_{fmt}"] = {"eps": float(v)}
+    ctl = sink.get("controller")
+    drift: List[str] = []
+    if isinstance(ctl, dict) and ctl:
+        drift = sorted(
+            f"missing:{k}" for k in set(SINK_CONTROLLER_KEYS) - set(ctl)
+        ) + sorted(
+            f"extra:{k}" for k in set(ctl) - set(SINK_CONTROLLER_KEYS)
+        )
+    return configs, ctl if isinstance(ctl, dict) and ctl else None, drift
 
 
 # ----------------------------------------------------------------- ingestion
@@ -194,24 +246,34 @@ def parse_artifact(doc: Any) -> Dict[str, Any]:
     bench.py artifact, the driver wrapper (parsed preferred, tail
     salvaged), and anything else as an empty round."""
     if isinstance(doc, dict) and isinstance(doc.get("configs"), dict):
+        sink_cfgs, ctl, drift = _sink_configs(doc)
+        configs = dict(doc["configs"])
+        configs.update(sink_cfgs)
         return {
-            "configs": doc["configs"],
+            "configs": configs,
             "tunnel_degraded": doc.get("tunnel_degraded"),
             "platform": doc.get("platform"),
             "mode": artifact_mode(doc),
+            "sink_controller": ctl,
+            "sink_controller_drift": drift,
             "salvaged": False,
-            "empty": not doc["configs"],
+            "empty": not configs,
         }
     if isinstance(doc, dict) and ("tail" in doc or "parsed" in doc):
         parsed = doc.get("parsed")
         if isinstance(parsed, dict) and isinstance(parsed.get("configs"), dict):
+            sink_cfgs, ctl, drift = _sink_configs(parsed)
+            configs = dict(parsed["configs"])
+            configs.update(sink_cfgs)
             return {
-                "configs": parsed["configs"],
+                "configs": configs,
                 "tunnel_degraded": parsed.get("tunnel_degraded"),
                 "platform": parsed.get("platform"),
                 "mode": artifact_mode(parsed),
+                "sink_controller": ctl,
+                "sink_controller_drift": drift,
                 "salvaged": False,
-                "empty": not parsed["configs"],
+                "empty": not configs,
             }
         tail = doc.get("tail") or ""
         configs, top = salvage_configs(tail)
@@ -326,10 +388,13 @@ def find_regressions(
     round is tunnel_degraded -- or the two rounds self-describe
     DIFFERENT platforms (cpu vs tpu) or DIFFERENT bench modes
     (full vs quick/smoke: a deliberate workload-size delta, not a code
-    regression) -- come back with ``"excused": True``: reported, never
-    failed on."""
+    regression) -- or either side was salvaged from a truncated tail
+    (the numbers survived; the run context that qualifies them did
+    not: not a trustworthy comparison endpoint) -- come back with
+    ``"excused": True``: reported, never failed on."""
     out: List[Dict[str, Any]] = []
     degraded = [bool(rec["tunnel_degraded"]) for rec in rounds]
+    salvaged = [bool(rec.get("salvaged")) for rec in rounds]
     platforms = [rec.get("platform") for rec in rounds]
     modes = [rec.get("mode") for rec in rounds]
     names = [rec["round"] for rec in rounds]
@@ -351,6 +416,8 @@ def find_regressions(
                             excuse = "platform_change"
                         elif mode_change(modes[prev_i], modes[i]):
                             excuse = "mode_change"
+                        elif salvaged[i] or salvaged[prev_i]:
+                            excuse = "salvaged_artifact"
                         out.append(
                             {
                                 "config": config,
@@ -498,6 +565,16 @@ def render_table(
             tags.append("salvaged from truncated tail")
         if rec["tunnel_degraded"]:
             tags.append("tunnel_degraded")
+        ctl = rec.get("sink_controller")
+        if ctl:
+            tags.append(
+                "drain ctl: emit "
+                f"{ctl.get('target_emit_ms')} ms, gc_group "
+                f"{ctl.get('gc_group')}, suggest_t {ctl.get('suggest_t')}"
+            )
+        drift = rec.get("sink_controller_drift")
+        if drift:
+            tags.append(f"controller key drift ({', '.join(drift)})")
         if tags:
             notes.append(f"  {rec['round']}: {', '.join(tags)}")
     if notes:

@@ -32,7 +32,10 @@ jax.config.update(
     "jax_compilation_cache_dir",
     os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
 )
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# Cache EVERY program: the differential harness compiles hundreds of
+# small (<0.5 s) programs whose compile walls only matter in aggregate
+# -- on a single-core CI host they are most of the suite's wall.
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 try:  # private JAX API; guarded so a JAX upgrade degrades gracefully
     from jax._src import xla_bridge as _xb  # noqa: E402
 

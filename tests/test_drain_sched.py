@@ -1,0 +1,215 @@
+"""Adaptive drain scheduler (parallel/drain_sched.py, ISSUE 17).
+
+Pins the control law (AIMD on target_emit_ms, budgeted pow2 gc_group
+steps with an explicit group flush), the compile-flatness of steady
+state (the jit_audit contract: an armed controller whose knobs have
+settled adds ZERO retraces), and the `cep_drain_controller_*` gauges.
+"""
+import random
+
+import pytest
+
+from kafkastreams_cep_tpu import Event, QueryBuilder, compile_pattern
+from kafkastreams_cep_tpu.obs.registry import MetricsRegistry
+from kafkastreams_cep_tpu.ops.engine import EngineConfig
+from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA, DrainController
+from kafkastreams_cep_tpu.pattern.expressions import value
+
+TS = 1_000_000
+
+
+def abc_pattern():
+    return (
+        QueryBuilder()
+        .select("a").where(value() == "A")
+        .then().select("b").where(value() == "B")
+        .then().select("c").where(value() == "C")
+        .build()
+    )
+
+
+def mk_engine(reg, *, gc_group=1, compile_telemetry=False, **cfg_kw):
+    cfg = EngineConfig(lanes=8, nodes=64, matches=32, gc_group=gc_group,
+                       **cfg_kw)
+    return BatchedDeviceNFA(
+        compile_pattern(abc_pattern()), keys=["k0", "k1"], config=cfg,
+        drain_mode="flat", query_name="q1", registry=reg,
+        compile_telemetry=compile_telemetry,
+    )
+
+
+def feed(bat, n, start=0):
+    evs = {
+        k: [Event(k, "ABC"[i % 3], TS + start + i, "t", 0, start + i)
+            for i in range(n)]
+        for k in ("k0", "k1")
+    }
+    bat.advance(evs)
+
+
+def test_controller_arms_emit_dial():
+    reg = MetricsRegistry()
+    bat = mk_engine(reg)
+    assert bat.target_emit_ms is None
+    ctl = DrainController(bat, max_emit_ms=800.0, registry=reg)
+    assert bat.target_emit_ms == 800.0
+    st = ctl.state()
+    assert st["target_emit_ms"] == 800.0
+    assert st["gc_group"] == 1
+
+
+def test_emit_decreases_on_hot_p99_and_relaxes_when_cool():
+    reg = MetricsRegistry()
+    bat = mk_engine(reg)
+    ctl = DrainController(bat, target_p99_ms=500.0, min_emit_ms=2.0,
+                          max_emit_ms=1000.0, registry=reg)
+    h = reg.histogram(
+        "cep_match_latency_seconds", "", labels=("query",)
+    ).labels(query="q1")
+    for _ in range(40):
+        h.observe(2.0)  # p99 == 2000 ms, 4x over target
+    before = bat.target_emit_ms
+    for _ in range(6):
+        ctl.observe()
+    assert bat.target_emit_ms < before / 8  # multiplicative decrease
+    floor = bat.target_emit_ms
+    # Cool the histogram (reservoir refills with fast samples) and the
+    # ring is empty: multiplicative-increase back toward the ceiling.
+    for _ in range(2000):
+        h.observe(0.001)
+    for _ in range(40):
+        ctl.observe()
+    assert bat.target_emit_ms > floor
+    assert bat.target_emit_ms <= 1000.0
+
+
+def test_emit_decreases_on_hot_ring_without_latency_signal():
+    """No latency histogram at all (bench drives the engine directly):
+    ring occupancy alone must tighten the cadence."""
+    reg = MetricsRegistry()
+    bat = mk_engine(reg, matches_per_step=4)
+    ctl = DrainController(bat, registry=reg)
+    before = bat.target_emit_ms
+    # Fake a hot probe observation: ring 60% full.
+    bat._pos_obs = (bat._pend_accum, int(bat.config.matches * 0.6),
+                    bat.config.nodes // 2)
+    ctl.observe()
+    assert bat.target_emit_ms < before
+
+
+def test_gc_group_steps_are_budgeted_and_flush_first():
+    reg = MetricsRegistry()
+    bat = mk_engine(reg, gc_group=8)
+    ctl = DrainController(bat, compile_budget=2, cooldown=1, registry=reg)
+    feed(bat, 6)
+    assert bat._group_ys  # pending window under the old cadence
+    flushes_before = bat.flushes
+    # Hot region: fill fraction > 0.75 -> halve, flushing the group first.
+    bat._pos_obs = (bat._pend_accum, 0, int(bat.config.nodes * 0.9))
+    ctl.observe()
+    assert bat.gc_group == 4
+    assert bat.flushes == flushes_before + 1
+    assert not bat._group_ys
+    st = ctl.state()
+    assert st["gc_changes"] == 1
+    # Second step spends the budget...
+    bat._pos_obs = (bat._pend_accum, 0, int(bat.config.nodes * 0.9))
+    ctl.observe()
+    assert bat.gc_group == 2
+    # ...after which the knob is FROZEN no matter the signal.
+    for _ in range(10):
+        bat._pos_obs = (bat._pend_accum, 0, int(bat.config.nodes * 0.9))
+        ctl.observe()
+    assert bat.gc_group == 2
+    assert ctl.state()["gc_changes"] == 2
+
+
+def test_gc_group_grows_only_when_post_wall_dominates():
+    reg = MetricsRegistry()
+    bat = mk_engine(reg, gc_group=2)
+    ctl = DrainController(bat, cooldown=1, registry=reg)
+    # Cool region, but no profiling samples: no growth signal.
+    bat._pos_obs = (bat._pend_accum, 0, 0)
+    ctl.observe()
+    assert bat.gc_group == 2
+    # Feed the sampled walls: post dominates advance -> double.
+    h = reg.get("cep_advance_compute_seconds")
+    h.labels(instance=bat.instance_id, phase="advance").observe(0.001)
+    h.labels(instance=bat.instance_id, phase="post").observe(0.010)
+    bat._pos_obs = (bat._pend_accum, 0, 0)
+    ctl.observe()
+    assert bat.gc_group == 4
+
+
+def test_cooldown_spaces_gc_steps():
+    reg = MetricsRegistry()
+    bat = mk_engine(reg, gc_group=16)
+    ctl = DrainController(bat, cooldown=5, compile_budget=8, registry=reg)
+    for i in range(10):
+        bat._pos_obs = (bat._pend_accum, 0, int(bat.config.nodes * 0.9))
+        ctl.observe()
+    # 10 ticks / cooldown 5 -> exactly 2 steps: 16 -> 8 -> 4.
+    assert bat.gc_group == 4
+
+
+def test_steady_state_is_compile_flat():
+    """The jit_audit pin: with the controller armed and knobs settled,
+    continued advances + controller ticks add zero new compiles."""
+    reg = MetricsRegistry()
+    bat = mk_engine(reg, compile_telemetry=True, matches_per_step=4)
+    ctl = DrainController(bat, registry=reg)
+    for i in range(4):
+        feed(bat, 6, start=i * 6)
+        ctl.observe(events=12)
+    bat.drain()
+    settled = bat.compile_watch.seen_count
+    for i in range(4, 10):
+        feed(bat, 6, start=i * 6)
+        ctl.observe(events=12)
+        bat.drain()
+    assert bat.compile_watch.seen_count == settled, (
+        "drain controller caused retraces in steady state"
+    )
+    assert ctl.state()["compiles_seen"] == settled
+
+
+def test_suggest_t_tracks_rate_and_budget():
+    reg = MetricsRegistry()
+    bat = mk_engine(reg)
+    ctl = DrainController(bat, t_min=8, t_max=512, registry=reg)
+    assert ctl.suggest_t() == 8  # no rate observed yet
+    ctl._rate_ev_s = 20_000.0  # 10k ev/s per key
+    bat.target_emit_ms = 100.0
+    # per-key 10k ev/s * 50 ms of budget = 500 events
+    assert ctl.suggest_t() == 500
+    bat.target_emit_ms = 1000.0
+    assert ctl.suggest_t() == 512  # clamped to t_max
+
+
+def test_controller_gauges_and_state_are_jsonable():
+    import json
+
+    reg = MetricsRegistry()
+    bat = mk_engine(reg)
+    ctl = DrainController(bat, registry=reg)
+    feed(bat, 6)
+    st = ctl.observe(events=12)
+    json.dumps(st)  # the soak/bench artifacts embed state() directly
+    snap = reg.snapshot()
+    for name in (
+        "cep_drain_controller_target_emit_ms",
+        "cep_drain_controller_gc_group",
+        "cep_drain_controller_occupancy_ratio",
+        "cep_drain_controller_adjustments_total",
+        "cep_drain_controller_p99_ms",
+    ):
+        assert name in snap, name
+
+
+def test_controller_validation():
+    reg = MetricsRegistry()
+    bat = mk_engine(reg)
+    with pytest.raises(ValueError):
+        DrainController(bat, target_p99_ms=0, registry=reg)
+    with pytest.raises(ValueError):
+        DrainController(bat, min_emit_ms=10, max_emit_ms=5, registry=reg)

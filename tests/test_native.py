@@ -272,6 +272,184 @@ def test_native_decode_unnormalized_group_falls_back():
     assert offs == sorted(offs), "Staged normalization lost"
 
 
+# ------------------------------------------------------- sink-to-bytes
+def _sink_both(query_fn, streams, cfg, fmt):
+    """Drive the same stream through an object-mode engine and a bytes-mode
+    (sink_format=fmt) engine with the native decoder; return (objects,
+    sink_matches) keyed dicts."""
+    from kafkastreams_cep_tpu.native import load_decoder
+
+    if load_decoder() is None:
+        pytest.skip("native decoder unavailable (no compiler?)")
+    keys = sorted(streams)
+    outs = []
+    for sink in ("objects", fmt):
+        bat = BatchedDeviceNFA(
+            query_fn(), keys=keys, config=cfg, drain_mode="flat",
+            sink_format=sink, query_name="q1",
+        )
+        got = {}
+        n = max(len(s) for s in streams.values())
+        for b in range(0, n, 16):
+            chunk = {k: s[b : b + 16] for k, s in streams.items()}
+            for k, v in bat.advance(chunk).items():
+                got.setdefault(k, []).extend(v)
+        assert bat._native_decoder() is not None
+        outs.append(got)
+    return outs
+
+
+@pytest.mark.parametrize("query_fn", [_letters_query, _stock_query])
+def test_native_sink_json_parity(query_fn):
+    """decode_matches_json payloads are byte-equal to host-Python
+    serialization of the object path's Sequences, the ident frames hash
+    to the object path's sequence_identity, and the carried last_event
+    matches -- on both a scalar stream and dict-valued stock events (the
+    value["name"] serializer branch)."""
+    import hashlib
+
+    from kafkastreams_cep_tpu.streams.emission import (
+        sequence_ident_frames, sequence_identity, identity_prefix,
+    )
+    from kafkastreams_cep_tpu.streams.serde import sequence_to_json_bytes
+
+    if "" in query_fn().schema.fields:
+        streams = {
+            f"k{i}": _mk_events(f"k{i}", list("ABCXABCABCXX" * 2))
+            for i in range(3)
+        }
+    else:
+        from kafkastreams_cep_tpu.models.stocks import GOLDEN_EVENTS
+
+        streams = {
+            "k1": _mk_events("k1", list(GOLDEN_EVENTS)),
+            "k2": _mk_events("k2", list(GOLDEN_EVENTS), topic="u"),
+        }
+    cfg = EngineConfig(lanes=32, nodes=512, matches=256, matches_per_step=16)
+    obj, sink = _sink_both(query_fn, streams, cfg, "json")
+    assert set(obj) == set(sink)
+    total = 0
+    for k in obj:
+        assert len(obj[k]) == len(sink[k])
+        for seq, sm in zip(obj[k], sink[k]):
+            assert sm.payload == sequence_to_json_bytes(seq)
+            assert sm.ident == sequence_ident_frames(seq)
+            h = hashlib.blake2b(digest_size=16)
+            h.update(identity_prefix("q1", k))
+            h.update(sm.ident)
+            assert h.digest() == sequence_identity("q1", k, seq)
+            assert sm.last_event == seq.matched[-1].events[-1]
+            assert sm.sequence is None  # zero object materialization
+            total += 1
+    assert total > 0
+
+
+def test_native_sink_arrow_parity():
+    """decode_matches_arrow buffers wrap (zero-copy) into IPC streams
+    byte-equal to host-Python Arrow serialization of the object path's
+    Sequences, with the same ident frames."""
+    from kafkastreams_cep_tpu.streams.emission import sequence_ident_frames
+    from kafkastreams_cep_tpu.streams.serde import sequence_to_arrow_ipc
+
+    streams = {
+        f"k{i}": _mk_events(f"k{i}", list("ABCXABCABCXX" * 2))
+        for i in range(3)
+    }
+    cfg = EngineConfig(lanes=32, nodes=512, matches=256, matches_per_step=16)
+    obj, sink = _sink_both(_letters_query, streams, cfg, "arrow")
+    assert set(obj) == set(sink)
+    total = 0
+    for k in obj:
+        assert len(obj[k]) == len(sink[k])
+        for seq, sm in zip(obj[k], sink[k]):
+            assert sm.payload == sequence_to_arrow_ipc(seq)
+            assert sm.ident == sequence_ident_frames(seq)
+            total += 1
+    assert total > 0
+
+
+def test_native_sink_exotic_values_decoder_level():
+    """Every write_json_value branch in decoder.cc -- string escaping
+    (quotes, control chars, unicode, astral surrogate pairs), int/float
+    repr, NaN/Infinity spellings, None/bool literals, and the fragment_fn
+    callback for dicts -- against the host reference, at the decoder call
+    level (exotic values cannot ride the device value column)."""
+    from kafkastreams_cep_tpu.core.sequence import Sequence as Seq, Staged
+    from kafkastreams_cep_tpu.native import load_decoder
+    from kafkastreams_cep_tpu.streams.serde import (
+        arrow_ipc_from_columns, json_fragment, sink_match_from_sequence,
+    )
+
+    native = load_decoder()
+    if native is None:
+        pytest.skip("native decoder unavailable (no compiler?)")
+    vals = [
+        "A", 'quote" back\\slash', "ctrl\x01\n\tchars", "café €",
+        "astral \U0001f600", 7, -3, 2.5, 0.1, float("nan"), float("inf"),
+        float("-inf"), None, True, False, {"name": "B", "price": 3},
+        {"price": 9}, 10**40,
+    ]
+    events = {g: Event("k", v, 1000 + g, "t", 0, g) for g, v in enumerate(vals)}
+    name_of_id = ["a", "b"]
+    Mb, Cb = len(vals) // 2, 4
+    counts = np.array([Mb], np.int32)
+    gidx = np.full((1, Mb, Cb), -1, np.int32)
+    name = np.zeros_like(gidx)
+    live = np.zeros_like(gidx)
+    for j in range(Mb):  # chains stored newest-first: (b, 2j+1), (a, 2j)
+        gidx[0, j, 0], name[0, j, 0], live[0, j, 0] = 2 * j + 1, 1, 1
+        gidx[0, j, 1], name[0, j, 1], live[0, j, 1] = 2 * j, 0, 1
+    args = (counts, gidx, name, live, name_of_id, events, Staged, Seq)
+    ref = native.decode_matches_flat(*args, None)[0]
+    assert len(ref) == Mb
+    got_j = native.decode_matches_json(*args, json_fragment)[0]
+    got_a = native.decode_matches_arrow(*args, json_fragment)[0]
+    for (payload, ident, last), seq in zip(got_j, ref):
+        want = sink_match_from_sequence(seq, "json")
+        assert payload == want.payload
+        assert ident == want.ident
+        assert last is seq.matched[-1].events[-1]
+    for (so, sd, vo, vd, rows, ident, last), seq in zip(got_a, ref):
+        want = sink_match_from_sequence(seq, "arrow")
+        assert arrow_ipc_from_columns(so, sd, vo, vd, rows) == want.payload
+        assert ident == want.ident
+
+
+def test_native_sink_json_branchy_groups():
+    """Multi-event one_or_more groups through the bytes path: group
+    normalization (and the Staged fallback for unordered offsets) must
+    produce the same bytes as host serialization."""
+    import random
+
+    from kafkastreams_cep_tpu import QueryBuilder
+    from kafkastreams_cep_tpu.pattern.expressions import value
+    from kafkastreams_cep_tpu.streams.serde import sequence_to_json_bytes
+
+    def query_fn():
+        pattern = (
+            QueryBuilder()
+            .select("first").one_or_more().where(value() == "C")
+            .then().select("latest").where(value() == "D")
+            .build()
+        )
+        return compile_query(compile_pattern(pattern), None)
+
+    rng = random.Random(11)
+    streams = {
+        f"k{i}": _mk_events(f"k{i}", [rng.choice("CCDX") for _ in range(48)])
+        for i in range(4)
+    }
+    cfg = EngineConfig(lanes=32, nodes=1024, matches=512, matches_per_step=16)
+    obj, sink = _sink_both(query_fn, streams, cfg, "json")
+    total = 0
+    for k in obj:
+        assert len(obj[k]) == len(sink[k])
+        for seq, sm in zip(obj[k], sink[k]):
+            assert sm.payload == sequence_to_json_bytes(seq)
+            total += 1
+    assert total > 30  # real match volume through the bytes walk
+
+
 # ------------------------------------------------------------- sanitizers
 @pytest.mark.slow
 def test_native_sanitizer_pass():

@@ -515,6 +515,8 @@ def _valid_artifact():
         # ISSUE 15: the wire-transport loopback pass (None outside
         # --smoke).
         "transport": None,
+        # ISSUE 17: the sink-to-bytes pass (None outside --smoke).
+        "sink": None,
         # ISSUE 9: compile telemetry + regression verdict blocks.
         "compile": {
             "fns": {
@@ -546,6 +548,9 @@ def _valid_artifact():
             # when the prior predates self-described platforms).
             "platform_prev": None,
             "platform_cur": "cpu",
+            # ISSUE 17: mode-change excusal self-description.
+            "mode_prev": None,
+            "mode_cur": "quick",
         },
     }
 

@@ -1,0 +1,330 @@
+"""Sink-to-bytes golden parity (ISSUE 17 tentpole pin).
+
+`sink_format="json"|"arrow"` decodes the flat chain table straight to
+sink payload bytes (native/decoder.cc decode_matches_json/arrow). The
+correctness contract this suite pins:
+
+  * payloads are BYTE-EQUAL to host-Python serialization of the object
+    path's decoded Sequences -- across engines (xla, pallas_interpret),
+    mid-stream drain boundaries, capacity pressure (GC-dropped chains),
+    and an out-of-order event-time-gated stream;
+  * EmissionGate digests are IDENTICAL between the object path
+    (`admit(key, seq)`) and the bytes path (`admit_ident(key, frames)`),
+    including occurrence qualification and crash-recovery dedup -- the
+    sink topic's record keys are the observable;
+  * the exactly-once recovery path is format-agnostic (digests ride the
+    sink records either way).
+"""
+import random
+
+import pytest
+
+from kafkastreams_cep_tpu import Event, QueryBuilder, Selected, compile_pattern
+from kafkastreams_cep_tpu.ops.engine import EngineConfig
+from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA
+from kafkastreams_cep_tpu.pattern.expressions import agg, value
+from kafkastreams_cep_tpu.streams.serde import (
+    SinkMatch,
+    sequence_to_arrow_ipc,
+    sequence_to_json_bytes,
+)
+
+TS = 1_000_000
+
+REF = {"json": sequence_to_json_bytes, "arrow": sequence_to_arrow_ipc}
+
+
+def abc_pattern():
+    return (
+        QueryBuilder()
+        .select("a").where(value() == "A")
+        .then().select("b").where(value() == "B")
+        .then().select("c").where(value() == "C")
+        .build()
+    )
+
+
+def branching_pattern():
+    return (
+        QueryBuilder()
+        .select("first").where(value() == "A")
+        .fold("cnt", agg("cnt", default=0) + 1)
+        .then().select("second", Selected.with_skip_til_any_match())
+        .one_or_more().where(value() == "C")
+        .then().select("latest").where(value() == "D")
+        .build()
+    )
+
+
+def letter_stream(seed, n, key=None, letters="ABCD"):
+    rng = random.Random(seed)
+    return [
+        Event(key or f"k{seed}", rng.choice(letters), TS + i, "t", 0, i)
+        for i in range(n)
+    ]
+
+
+def drive(pattern, streams, splits, config, *, sink_format="objects",
+          engine="xla", native=True, **kw):
+    keys = list(streams)
+    bat = BatchedDeviceNFA(
+        compile_pattern(pattern), keys=keys, config=config,
+        drain_mode="flat", sink_format=sink_format, engine=engine,
+        query_name="q1", **kw,
+    )
+    if not native:
+        bat._native_dec = None
+    got = {k: [] for k in keys}
+    for lo, hi in splits:
+        chunk = {k: evs[lo:hi] for k, evs in streams.items() if evs[lo:hi]}
+        if not chunk:
+            continue
+        for k, seqs in bat.advance(chunk).items():
+            got[k].extend(seqs)
+    return got, bat
+
+
+def assert_parity(obj, sink, fmt):
+    """Bytes run == serialize(object run), match for match, in order."""
+    assert set(k for k, v in obj.items() if v) == set(
+        k for k, v in sink.items() if v
+    )
+    total = 0
+    for k, seqs in obj.items():
+        sms = sink[k]
+        assert len(sms) == len(seqs), k
+        for sm, seq in zip(sms, seqs):
+            assert isinstance(sm, SinkMatch)
+            assert sm.format == fmt
+            assert sm.payload == REF[fmt](seq)
+            assert sm.last_event == seq.matched[-1].events[-1]
+            total += 1
+    return total
+
+
+@pytest.mark.parametrize("engine", ["xla", "pallas_interpret"])
+@pytest.mark.parametrize("fmt", ["json", "arrow"])
+def test_sink_parity_engines(engine, fmt):
+    """Native sink bytes == host serialization of the object path, across
+    both compute engines and mid-stream drain boundaries."""
+    config = EngineConfig(lanes=32, nodes=256, matches=64,
+                          matches_per_step=16, nodes_per_step=16)
+    streams = {f"k{i}": letter_stream(300 + i, 18) for i in range(3)}
+    splits = [(0, 7), (7, 12), (12, 100)]
+    obj, _ = drive(branching_pattern(), streams, splits, config,
+                   engine=engine)
+    sink, bat = drive(branching_pattern(), streams, splits, config,
+                      sink_format=fmt, engine=engine)
+    assert assert_parity(obj, sink, fmt) > 0
+    assert bat._native_decoder() is not None
+
+
+@pytest.mark.parametrize("fmt", ["json", "arrow"])
+def test_sink_parity_python_fallback(fmt):
+    """The host-Python fallback (no native module) produces the same
+    SinkMatch bytes -- plus the object-path Sequence it serialized."""
+    config = EngineConfig(lanes=32, nodes=256, matches=64,
+                          matches_per_step=16)
+    streams = {f"k{i}": letter_stream(41 + i, 16) for i in range(2)}
+    splits = [(0, 9), (9, 100)]
+    obj, _ = drive(branching_pattern(), streams, splits, config)
+    sink, _ = drive(branching_pattern(), streams, splits, config,
+                    sink_format=fmt, native=False)
+    assert assert_parity(obj, sink, fmt) > 0
+    for sms in sink.values():
+        for sm in sms:
+            assert sm.sequence is not None  # fallback decodes objects
+
+
+@pytest.mark.parametrize("fmt", ["json", "arrow"])
+def test_sink_parity_capacity_pressure(fmt):
+    """Under node-region overflow (node_drops > 0, GC-dropped chains) the
+    bytes path must degrade IDENTICALLY to the object path: dead chains
+    decode to nothing, survivors byte-match."""
+    config = EngineConfig(lanes=64, nodes=48, matches=128,
+                          matches_per_step=16)
+    streams = {f"k{i}": letter_stream(500 + i, 40) for i in range(2)}
+    splits = [(0, 14), (14, 27), (27, 100)]
+    obj, bo = drive(branching_pattern(), streams, splits, config)
+    sink, bs = drive(branching_pattern(), streams, splits, config,
+                     sink_format=fmt)
+    assert bs.stats == bo.stats
+    assert assert_parity(obj, sink, fmt) > 0
+
+
+def test_sink_json_out_of_order_event_time_gated():
+    """An out-of-order stream behind the event-time gate (reorder buffer
+    + watermark release) must emit identical sink bytes and identical
+    emission digests in objects and json modes -- the gate feeds the
+    engine in event-time order either way, so the parity pin extends
+    through the reorder plane."""
+    from kafkastreams_cep_tpu.streams.builder import ComplexStreamsBuilder
+    from kafkastreams_cep_tpu.streams.log import RecordLog
+
+    # Bounded shuffle of an ABC stream: at most 3 positions displaced.
+    letters = list("ABCXABCABCXABC")
+    evs = [(v, TS + i) for i, v in enumerate(letters)]
+    rng = random.Random(13)
+    arrival = list(evs)
+    for i in range(0, len(arrival) - 3, 3):
+        j = i + rng.randint(0, 2)
+        arrival[i], arrival[j] = arrival[j], arrival[i]
+
+    def run(sink_format):
+        log = RecordLog()
+        b = ComplexStreamsBuilder(log=log, app_id="oo")
+        opts = {} if sink_format == "objects" else {
+            "sink_format": sink_format, "drain_mode": "flat",
+        }
+        (b.stream("letters")
+          .query("q1", abc_pattern(), runtime="tpu", batch_size=4,
+                 config=EngineConfig(lanes=16, nodes=256, matches=64,
+                                     reorder_capacity=32, lateness_ms=4),
+                 **opts)
+          .to("matches"))
+        topo = b.build()
+        for off, (v, ts) in enumerate(arrival):
+            topo.process("letters", "K", v, timestamp=ts, offset=off)
+        topo.flush_event_time()
+        topo.flush()
+        return [(r.key, r.value) for r in log.read("matches")]
+
+    obj = run("objects")
+    js = run("json")
+    assert len(obj) == len(js) > 0
+    # Sink keys carry the emission digests: byte-equal keys == digest
+    # parity; byte-equal values == payload parity.
+    assert obj == js
+
+
+@pytest.mark.parametrize("fmt", ["json", "arrow"])
+def test_sink_topology_digest_and_dedup_parity(fmt):
+    """Topology-level: same sink record keys (digests) in objects and
+    bytes modes, including the duplicate-match occurrence qualification,
+    and the recovery dedup window accepts bytes-mode digests."""
+    from kafkastreams_cep_tpu.streams.builder import ComplexStreamsBuilder
+    from kafkastreams_cep_tpu.streams.log import RecordLog
+
+    stream = list("ABCABCXABC")
+
+    def run(sink_format):
+        log = RecordLog()
+        b = ComplexStreamsBuilder(log=log, app_id="dd")
+        opts = {} if sink_format == "objects" else {
+            "sink_format": sink_format, "drain_mode": "flat",
+        }
+        (b.stream("letters")
+          .query("q1", abc_pattern(), runtime="tpu", batch_size=3,
+                 config=EngineConfig(lanes=16, nodes=256, matches=64),
+                 **opts)
+          .to("matches"))
+        topo = b.build()
+        for off, v in enumerate(stream):
+            topo.process("letters", "K", v, timestamp=TS + off, offset=off)
+        topo.flush()
+        recs = log.read("matches")
+        return topo, [(r.key, r.value) for r in recs]
+
+    _, obj = run("objects")
+    topo, got = run(fmt)
+    assert [k for k, _ in obj] == [k for k, _ in got]
+    if fmt == "json":
+        assert [v for _, v in obj] == [v for _, v in got]
+    # Recovery over bytes-mode sink records: recover() re-reads the tail
+    # and seeds the dedup window with the same digests.
+    node = topo.queries[0][1]
+    node.gate._emitted.clear()
+    n = node.gate.recover(topo.log, ["matches"])
+    assert n == len(got)
+
+
+def test_sink_format_validation():
+    cfg = EngineConfig(lanes=8, nodes=64, matches=16)
+    q = compile_pattern(abc_pattern())
+    with pytest.raises(ValueError, match="sink_format"):
+        BatchedDeviceNFA(q, keys=["k"], config=cfg, sink_format="csv")
+    with pytest.raises(ValueError, match="flat"):
+        BatchedDeviceNFA(q, keys=["k"], config=cfg, drain_mode="pool",
+                         sink_format="json")
+    from kafkastreams_cep_tpu.ops.tables import compile_multi_query
+
+    mq = compile_multi_query(
+        [("qa", abc_pattern()), ("qb", abc_pattern())], None
+    )
+    with pytest.raises(ValueError, match="stacked"):
+        BatchedDeviceNFA(mq, keys=["k"], config=cfg, sink_format="json")
+
+
+def test_sink_bytes_replay_boundary_parity():
+    """Exact-replay boundaries (fold-divergence recovery) in bytes mode:
+    oracle-replayed matches re-serialize through the host reference and
+    must byte-match the object-mode run of the same stream."""
+    rng = random.Random(50_072)
+    pattern = (
+        QueryBuilder()
+        .select("s0").where(value() == "A")
+        .then().select("s1", Selected.with_skip_til_any_match())
+        .one_or_more().where(value() == "B")
+        .fold("cnt", agg("cnt", default=0) + 1)
+        .then().select("s2").where(
+            (value() == "C") & (agg("cnt", default=0) <= 2)
+        )
+        .build()
+    )
+    keys = ["kA", "kB"]
+    streams = {}
+    for key in keys:
+        ts = 1000
+        events = []
+        for i in range(20):
+            ts += rng.choice([0, 1, 1, 2])
+            events.append(Event(key, rng.choice("ABCD"), ts, "t", 0, i))
+        streams[key] = events
+    config = EngineConfig(lanes=256, nodes=2048, matches=1024,
+                          matches_per_step=128)
+    splits = [(0, 5), (5, 10), (10, 15), (15, 100)]
+    obj, bo = drive(pattern, streams, splits, config, exact_replay=True)
+    sink, bs = drive(pattern, streams, splits, config, sink_format="json",
+                     exact_replay=True)
+    assert bs.replays == bo.replays
+    assert assert_parity(obj, sink, "json") > 0
+
+
+def test_sink_metrics_registered():
+    """cep_sink_matches_total / cep_sink_bytes_total count the bytes-mode
+    decode (labels query, format)."""
+    from kafkastreams_cep_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    config = EngineConfig(lanes=8, nodes=64, matches=32)
+    streams = {"k0": [Event("k0", "ABC"[i % 3], TS + i, "t", 0, i)
+                      for i in range(12)]}
+    got, _ = drive(abc_pattern(), streams, [(0, 100)], config,
+                   sink_format="json", registry=reg)
+    n = sum(len(v) for v in got.values())
+    assert n > 0
+    fam = reg.get("cep_sink_matches_total")
+    assert fam.labels(query="q1", format="json").value == n
+    total = sum(len(sm.payload) for v in got.values() for sm in v)
+    assert reg.get("cep_sink_bytes_total").labels(
+        query="q1", format="json"
+    ).value == total
+
+
+def test_sink_bytes_provenance_sampling():
+    """Sampled matches re-decode through the object path: the SinkMatch
+    carries the materialized Sequence with provenance attached, the ring
+    records the exemplar, and the payload still byte-matches."""
+    config = EngineConfig(lanes=32, nodes=256, matches=64,
+                          matches_per_step=16)
+    streams = {f"k{i}": letter_stream(70 + i, 16) for i in range(2)}
+    sink, bat = drive(branching_pattern(), streams, [(0, 100)], config,
+                      sink_format="json", provenance_sample=0.5)
+    n = sum(len(v) for v in sink.values())
+    sampled = [sm for v in sink.values() for sm in v if sm.sequence is not None]
+    assert n > 1
+    assert 0 < len(sampled) <= n
+    for sm in sampled:
+        assert sm.sequence.provenance is not None
+        assert sequence_to_json_bytes(sm.sequence) == sm.payload
+    assert len(bat.provenance_exemplars()) == len(sampled)
