@@ -23,6 +23,15 @@ events/s, batched events/s (engine-only and end-to-end including pack +
 decode), p99 per-batch latency ms, and engine drop counters (all zero in a
 correctly-sized run).
 
+Zero-knob sizing (ISSUE 18): every config starts from EngineConfig()
+DEFAULTS -- no hand-tuned lanes/nodes/matches tables. The capacity
+autosizer (parallel/drain_sched.py) settles the shape during warmup from
+the engine's own drop counters and occupancy probes; warmup drops are the
+sizing signal, and the timed passes report STEADY-STATE drops (post-settle
+deltas, zero in a converged run). `--no-autosize` pins the raw defaults
+for A/B runs; the artifact self-describes either way via the top-level
+`autosized` flag and the flagship's `autosize` block.
+
 Run on the ambient JAX platform (the real TPU under axon); --cpu forces the
 8-device virtual CPU mesh used by the test suite.
 """
@@ -84,10 +93,17 @@ def parse_args() -> argparse.Namespace:
         help="write the introspection pass's Chrome-trace/Perfetto "
         "timeline (spans + match exemplars) here (--smoke only)",
     )
+    ap.add_argument(
+        "--no-autosize", action="store_true",
+        help="pin raw EngineConfig() defaults instead of letting the "
+        "capacity autosizer settle the shape during warmup (A/B runs; "
+        "the artifact's `autosized` flag records the choice)",
+    )
     return ap.parse_args()
 
 
 ARGS = parse_args()
+ARGS.autosize = not ARGS.no_autosize
 if ARGS.smoke:
     ARGS.cpu = True
     ARGS.quick = True
@@ -121,7 +137,10 @@ from kafkastreams_cep_tpu.ops.engine import EngineConfig  # noqa: E402
 from kafkastreams_cep_tpu.ops.runtime import DeviceNFA  # noqa: E402
 from kafkastreams_cep_tpu.ops.schema import EventSchema  # noqa: E402
 from kafkastreams_cep_tpu.ops.tables import compile_query  # noqa: E402
-from kafkastreams_cep_tpu.parallel import BatchedDeviceNFA  # noqa: E402
+from kafkastreams_cep_tpu.parallel import (  # noqa: E402
+    BatchedDeviceNFA,
+    CapacityAutosizer,
+)
 from kafkastreams_cep_tpu.pattern.expressions import agg, field, value  # noqa: E402
 
 TS0 = 1_000_000
@@ -257,23 +276,64 @@ def skip_any8_stream(rng: random.Random, n: int) -> List[Event]:
     ]
 
 
+# Per-workload SEMANTIC knobs only (window strictness, GC policy) -- the
+# capacity axes (lanes/nodes/matches/per-step caps) are the autosizer's
+# job now; the hand-tuned tables this dict used to carry are retired
+# (ISSUE 18).
 WORKLOADS: Dict[str, Dict[str, Any]] = {
     "letters_strict": dict(
         pattern=letters_pattern, schema=None, stream=letters_stream,
-        config=EngineConfig(lanes=8, nodes=1024, matches=64),
+        semantics={},
     ),
     "stock_rising": dict(
         pattern=stock_pattern, schema=stock_schema, stream=stock_stream,
-        config=EngineConfig(lanes=256, nodes=8192, matches=1024,
-                            matches_per_step=128, nodes_per_step=256),
+        semantics={},
     ),
     "skip_any8": dict(
         pattern=skip_any8_pattern, schema=None, stream=skip_any8_stream,
-        config=EngineConfig(lanes=128, nodes=1024, matches=256, matches_per_step=16,
-                            nodes_per_step=64, strict_windows=True),
+        semantics=dict(strict_windows=True),
         strict=True,
     ),
 }
+
+DROP_KEYS = ("lane_drops", "node_drops", "match_drops")
+
+#: Bound on sizing-settle rounds: each round re-traces the warm batches
+#: and lets the drop law double every exhausted axis once (plus the ring
+#: page-guard), so 8 rounds covers a 256x miss from the defaults --
+#: far beyond any measured workload. A run that exhausts this reports
+#: its remaining drops as steady-state (loud), never silently retries.
+AUTOSIZE_ROUNDS = 8
+
+
+def _settle_autosizer(
+    bat: BatchedDeviceNFA, warm: Callable[[], None], events: int,
+    t: int,
+) -> Dict[str, Any]:
+    """Run warm passes until the autosizer stops resizing (ISSUE 18).
+
+    `warm` replays the warmup batches once (advance + drain: drains latch
+    the drop counters the control law reads); each round ends with one
+    control tick. Returns the `autosize` artifact block: the settled
+    state, rounds used, and the warmup drops consumed as sizing signal --
+    the caller re-baselines its drop reporting on the engine's counters
+    AFTER this returns so the timed pass reports steady-state drops.
+    """
+    auto = CapacityAutosizer(bat)
+    rounds = 0
+    for _ in range(AUTOSIZE_ROUNDS):
+        rounds += 1
+        before = bat.resizes
+        warm()
+        auto.observe(events=events, t=t)
+        if bat.resizes == before:
+            break
+    stats = bat.stats
+    return dict(
+        state=auto.state(),
+        settle_rounds=rounds,
+        warmup_drops={k: stats[k] for k in DROP_KEYS},
+    )
 
 
 # --------------------------------------------------------------------------
@@ -339,29 +399,57 @@ def bench_host_serde(
 
 def bench_device_single(
     pattern_fn: Callable, schema_fn, stream: List[Event],
-    config: EngineConfig, batch: int, n_batches: int,
+    semantics: Dict[str, Any], batch: int, n_batches: int,
 ) -> Dict[str, Any]:
-    """Single-key DeviceNFA: scan-per-batch, decode each batch."""
+    """Single-key DeviceNFA: scan-per-batch, decode each batch.
+
+    Self-sizing (ISSUE 18): starts from EngineConfig() defaults and, when
+    a pass ends with nonzero drop counters, doubles every exhausted axis
+    and reruns -- DeviceNFA has no in-place resize, so a rebuild retrace
+    IS the next attempt's warmup. The reported pass ran drop-free at the
+    settled shape (or carries its residual drops loudly)."""
+    from dataclasses import replace as _dc_replace
+
     schema = schema_fn() if schema_fn else None
-    dev = DeviceNFA(
-        compile_query(compile_pattern(pattern_fn()), schema), config=config,
-    )
-    # Warmup compiles the step/GC programs.
-    dev.advance(stream[:batch])
-    t0 = time.perf_counter()
-    n = 0
-    n_matches = 0
-    for b in range(1, n_batches):
-        chunk = stream[b * batch: (b + 1) * batch]
-        if len(chunk) < batch:
+    query = compile_query(compile_pattern(pattern_fn()), schema)
+    config = EngineConfig(**semantics)
+    attempts = 0
+    for _ in range(AUTOSIZE_ROUNDS if ARGS.autosize else 1):
+        attempts += 1
+        dev = DeviceNFA(query, config=config)
+        # Warmup compiles the step/GC programs.
+        dev.advance(stream[:batch])
+        t0 = time.perf_counter()
+        n = 0
+        n_matches = 0
+        for b in range(1, n_batches):
+            chunk = stream[b * batch: (b + 1) * batch]
+            if len(chunk) < batch:
+                break
+            n_matches += len(dev.advance(chunk))
+            n += len(chunk)
+        jax.block_until_ready(dev.state["n_events"])
+        dt = time.perf_counter() - t0
+        stats = dev.stats
+        if not ARGS.autosize or not any(stats[k] for k in DROP_KEYS):
             break
-        n_matches += len(dev.advance(chunk))
-        n += len(chunk)
-    jax.block_until_ready(dev.state["n_events"])
-    dt = time.perf_counter() - t0
-    stats = dev.stats
+        grown: Dict[str, int] = {}
+        if stats["lane_drops"]:
+            grown["lanes"] = config.lanes * 2
+        if stats["node_drops"]:
+            grown["nodes"] = config.nodes * 2
+        if stats["match_drops"]:
+            # Ring or per-step cap -- the counter cannot tell (same
+            # ambiguity the autosizer's match law handles): double both.
+            grown["matches"] = config.matches * 2
+            grown["matches_per_step"] = min(
+                grown["matches"], config.matches_per_step * 2
+            )
+        config = _dc_replace(config, **grown)
     return dict(
         events=n, seconds=dt, eps=n / dt, matches=n_matches,
+        sizing_attempts=attempts,
+        lanes=config.lanes, matches_per_step=config.matches_per_step,
         lane_drops=stats["lane_drops"], node_drops=stats["node_drops"],
         match_drops=stats["match_drops"],
     )
@@ -369,7 +457,7 @@ def bench_device_single(
 
 def bench_device_batched(
     pattern_fn: Callable, schema_fn, stream_fn: Callable,
-    config: EngineConfig, n_keys: int, batch: int, n_batches: int,
+    semantics: Dict[str, Any], n_keys: int, batch: int, n_batches: int,
     sink_format: str = "objects",
 ) -> Dict[str, Any]:
     """Multi-key batched engine: the throughput path.
@@ -379,9 +467,17 @@ def bench_device_batched(
     sink_format="json"/"arrow" (ISSUE 17) swaps the drain's decode stage
     for the native bytes emitter -- same tensors, SinkMatch out -- so the
     eps/e2e/latency deltas vs the objects run isolate decode cost.
+
+    Capacity is zero-knob (ISSUE 18): the engine arms at EngineConfig()
+    defaults plus the caller's SEMANTIC knobs and the autosizer settles
+    the shape during warmup (each settle round replays the warm batches
+    at the grown shape); drop counters are then re-baselined so the
+    reported figures are STEADY-STATE drops, with the warmup's sizing
+    signal preserved under the `autosize` block.
     """
     schema = schema_fn() if schema_fn else None
     query = compile_query(compile_pattern(pattern_fn()), schema)
+    config = EngineConfig(**semantics)
     bat = BatchedDeviceNFA(
         query, keys=[f"k{i}" for i in range(n_keys)], config=config,
         engine=ARGS.engine, provenance_sample=PROVENANCE_SAMPLE,
@@ -410,10 +506,22 @@ def bench_device_batched(
     # path at realistic bucket sizes -- a drain with real matches pending
     # compiles the closure walk, the sliced pulls and the decoder; the
     # empty-ring early return would leave those to the timed pass.
-    for xs in packed[:n_warm]:
-        bat.advance_packed(xs, decode=False)
-    bat.drain()
-    jax.block_until_ready(bat.state["n_events"])
+    # Packed [T, K] batches stay valid across resizes (T and K never
+    # change), so the settle rounds replay the same slices.
+    def _warm() -> None:
+        for xs in packed[:n_warm]:
+            bat.advance_packed(xs, decode=False)
+        bat.drain()
+        jax.block_until_ready(bat.state["n_events"])
+
+    if ARGS.autosize:
+        autosize_block = _settle_autosizer(
+            bat, _warm, events=n_warm * batch * n_keys, t=batch
+        )
+    else:
+        autosize_block = None
+        _warm()
+    base_drops = {k: bat.stats[k] for k in DROP_KEYS}
 
     # Throughput pass (engine-only): batches pre-packed, no per-batch sync.
     # The terminal drain is EXCLUDED from dt and reported as its own
@@ -489,7 +597,7 @@ def bench_device_batched(
         drain_s=drain_s,  # terminal drain, excluded from eps (own stage)
         e2e_eps=e2e_n / e2e_dt, e2e_matches=e2e_matches,
         lat_matches=lat_matches,
-        keys=n_keys, batch=batch, lanes=config.lanes, engine=bat.engine,
+        keys=n_keys, batch=batch, lanes=bat.config.lanes, engine=bat.engine,
         drain_mode=bat.drain_mode, sink_format=bat.sink_format,
         pack_eps=(n_warm + n_batches) * batch * n_keys / pack_s,
         p50_batch_ms=float(np.percentile(lat_ms, 50)),
@@ -499,14 +607,18 @@ def bench_device_batched(
         components=components,
         tunnel_mbps=components.get("tunnel_mbps"),
         drain_pull_bytes=int(bat.drain_pull_bytes),
-        lane_drops=stats["lane_drops"], node_drops=stats["node_drops"],
-        match_drops=stats["match_drops"],
+        autosize=autosize_block,
+        # Steady-state drops: deltas since the post-settle baseline (the
+        # warmup's drops were the sizing signal, recorded above).
+        lane_drops=stats["lane_drops"] - base_drops["lane_drops"],
+        node_drops=stats["node_drops"] - base_drops["node_drops"],
+        match_drops=stats["match_drops"] - base_drops["match_drops"],
     )
 
 
 def bench_device_latency(
     pattern_fn: Callable, schema_fn, stream_fn: Callable,
-    config: EngineConfig, n_keys: int, batch: int, n_batches: int,
+    semantics: Dict[str, Any], n_keys: int, batch: int, n_batches: int,
     target_emit_ms: float = None,
     pipelined: bool = False,
     profile_sync: bool = False,
@@ -530,6 +642,7 @@ def bench_device_latency(
     """
     schema = schema_fn() if schema_fn else None
     query = compile_query(compile_pattern(pattern_fn()), schema)
+    config = EngineConfig(**semantics)
     bat = BatchedDeviceNFA(
         query, keys=[f"k{i}" for i in range(n_keys)], config=config,
         engine=ARGS.engine, target_emit_ms=target_emit_ms,
@@ -552,9 +665,21 @@ def bench_device_latency(
 
     # Warmup across several batches: the first match-bearing drain is what
     # compiles the pull/decode programs (an empty drain early-returns).
-    for xs in packed[:n_warm]:
-        bat.advance_packed(xs, decode=True)
-    jax.block_until_ready(bat.state["n_events"])
+    # The autosizer settles the shape here (every batch decodes, so each
+    # per-batch drain latches the drop counters the control law reads).
+    def _warm() -> None:
+        for xs in packed[:n_warm]:
+            bat.advance_packed(xs, decode=True)
+        jax.block_until_ready(bat.state["n_events"])
+
+    if ARGS.autosize:
+        autosize_block = _settle_autosizer(
+            bat, _warm, events=n_warm * batch * n_keys, t=batch
+        )
+    else:
+        autosize_block = None
+        _warm()
+    base_drops = {k: bat.stats[k] for k in DROP_KEYS}
     bat.timings = BatchTimings(registry=bat.metrics)
     t0 = time.perf_counter()
     n_matches = 0
@@ -581,8 +706,10 @@ def bench_device_latency(
         p99_match_emit_ms=summary.get("emit_latency_ms_p99"),
         components=components,
         tunnel_mbps=components.get("tunnel_mbps"),
-        lane_drops=stats["lane_drops"], node_drops=stats["node_drops"],
-        match_drops=stats["match_drops"],
+        autosize=autosize_block,
+        lane_drops=stats["lane_drops"] - base_drops["lane_drops"],
+        node_drops=stats["node_drops"] - base_drops["node_drops"],
+        match_drops=stats["match_drops"] - base_drops["match_drops"],
     )
 
 
@@ -608,23 +735,16 @@ def bench_watermark(
     from kafkastreams_cep_tpu.time import BoundedOutOfOrderness, EventTimeGate
 
     REORDER_BOUND_MS = 6
-    if ARGS.quick:
-        # CI sizing (the pass checks the CODE PATH and the overhead
-        # arithmetic, not the flagship number): flagship planes make the
-        # two engines' compiles the whole wall on a 2-core box.
-        config = EngineConfig(
-            lanes=32, nodes=512, matches=2048, matches_per_step=16,
-            nodes_per_step=16, strict_windows=True, pin_interval=True,
-            reorder_capacity=max(4 * batch, 64),
-            lateness_ms=REORDER_BOUND_MS,
-        )
-    else:
-        config = EngineConfig(
-            lanes=288, nodes=3072, matches=16384, matches_per_step=64,
-            nodes_per_step=64, strict_windows=True, pin_interval=True,
-            reorder_capacity=max(4 * batch, 64),
-            lateness_ms=REORDER_BOUND_MS,
-        )
+    # Capacity is zero-knob (ISSUE 18): defaults + the pass's semantic
+    # knobs (window strictness, interval pinning, the reorder envelope).
+    # The in-order baseline settles the shape after its warmup and the
+    # gated treatment runs PINNED at that settled shape -- overhead_pct
+    # must compare identical engines, and a gate cannot replay warmup.
+    base_config = EngineConfig(
+        strict_windows=True, pin_interval=True,
+        reorder_capacity=max(4 * batch, 64),
+        lateness_ms=REORDER_BOUND_MS,
+    )
     query = compile_query(compile_pattern(skip_any8_pattern()), None)
     rng = random.Random(31)
     n_warm = 2
@@ -646,7 +766,7 @@ def bench_watermark(
         )
         return [events[i] for i in keyed]
 
-    def run(gated: bool) -> Dict[str, Any]:
+    def run(gated: bool, config: EngineConfig):
         bat = BatchedDeviceNFA(
             query, keys=list(streams), config=config, engine=ARGS.engine,
         )
@@ -721,6 +841,16 @@ def bench_watermark(
         drive(0, n_warm)
         bat.drain()
         jax.block_until_ready(bat.state["n_events"])
+        if ARGS.autosize and not gated:
+            # One-shot settle on the warmup's latched drop counters and
+            # occupancy probes (the gates' statefulness bars a replayed
+            # warmup here; residual under-sizing stays loud as drops).
+            auto = CapacityAutosizer(bat)
+            for _ in range(4):
+                before = bat.resizes
+                auto.observe(events=n_warm * batch * len(streams))
+                if bat.resizes == before:
+                    break
         lag_samples.clear()
         occ_samples.clear()
         t0 = time.perf_counter()
@@ -759,10 +889,10 @@ def bench_watermark(
             out["released"] = family_total("cep_reorder_released_total")
             out["lag_samples"] = lag_samples
             out["occupancy_peak"] = max(occ_samples, default=0)
-        return out
+        return out, bat.config
 
-    base = run(gated=False)
-    treat = run(gated=True)
+    base, settled_config = run(gated=False, config=base_config)
+    treat, _ = run(gated=True, config=settled_config)
     lag = treat.pop("lag_samples", []) or [0]
     return dict(
         inorder_eps=base["eps"],
@@ -806,17 +936,15 @@ def bench_multi_query(
             b = b.then().select(f"q{i}-{j}").where(value() == ch)
         return b.build()
 
-    # Lane pool hosts every query's runs per key; letters partials stay
-    # shallow so 8 lanes/query suffice for zero drops.
-    config = EngineConfig(
-        lanes=8 * n_queries, nodes=1024, matches=4096,
-        matches_per_step=4 * n_queries, nodes_per_step=8 * n_queries,
-        pin_interval=True,
-    )
+    # Zero-knob capacity (ISSUE 18): the lane pool hosts every query's
+    # runs per key, and the autosizer settles the shared shape during
+    # warmup from the stacked engine's own drop counters (the hand
+    # lanes/caps-per-query arithmetic this config used to carry is
+    # retired; pin_interval stays -- a semantic GC policy choice).
     eng = StackedQueryEngine(
         [(f"q{i}", query_pattern(i)) for i in range(n_queries)],
         keys=[f"k{k}" for k in range(n_keys)],
-        config=config,
+        config=EngineConfig(pin_interval=True),
         engine=ARGS.engine,
     )
     rng = random.Random(13)
@@ -827,8 +955,19 @@ def bench_multi_query(
         eng.pack({k: s[b * batch : (b + 1) * batch] for k, s in streams.items()})
         for b in range(n_batches)
     ]
-    eng.advance_packed(packed[0], decode=True)  # warmup
-    jax.block_until_ready(eng.engine.state["n_events"])
+
+    def _warm() -> None:
+        eng.advance_packed(packed[0], decode=True)
+        jax.block_until_ready(eng.engine.state["n_events"])
+
+    if ARGS.autosize:
+        autosize_block = _settle_autosizer(
+            eng.engine, _warm, events=batch * n_keys, t=batch
+        )
+    else:
+        autosize_block = None
+        _warm()
+    base_drops = {k: eng.stats[k] for k in DROP_KEYS}
 
     t0 = time.perf_counter()
     for b in range(1, n_batches):
@@ -845,8 +984,10 @@ def bench_multi_query(
         events=n, seconds=dt, eps=n / dt, matches=n_matches,
         queries=n_queries, keys=n_keys, batch=batch,
         engine=eng.engine.engine,
-        lane_drops=stats["lane_drops"], node_drops=stats["node_drops"],
-        match_drops=stats["match_drops"],
+        autosize=autosize_block,
+        lane_drops=stats["lane_drops"] - base_drops["lane_drops"],
+        node_drops=stats["node_drops"] - base_drops["node_drops"],
+        match_drops=stats["match_drops"] - base_drops["match_drops"],
     )
 
 
@@ -1105,9 +1246,14 @@ def bench_sink_bytes() -> Dict[str, Any]:
     """Smoke-only sink-to-bytes pass (ISSUE 17): the SAME stock stream
     through three flat-drain engines -- sink_format "objects" (Sequence
     decode), "json" and "arrow" (native bytes emission) -- with byte and
-    emission-digest parity pinned against the object path in-pass, and a
-    DrainController armed on the json engine (its chosen knobs ride the
-    artifact's `sink` block for the perf ledger).
+    emission-digest parity pinned against the object path in-pass.
+
+    Capacity is zero-knob (ISSUE 18): one throwaway engine drives the
+    whole stream under a CapacityAutosizer first and ALL THREE format
+    runs pin its settled shape -- the parity pins require identical drop
+    behavior, so the sizing decision is shared, never per-run. The
+    autosizer's state (capacity + nested cadence knobs) rides the
+    artifact's `sink.controller` block for the perf ledger.
 
     eps here compares DECODE paths, so the timed window is advance +
     terminal drain/decode together -- unlike the throughput configs,
@@ -1127,8 +1273,6 @@ def bench_sink_bytes() -> Dict[str, Any]:
     )
 
     n_keys, batch, n_batches = 4, 32, 5
-    cfg = EngineConfig(lanes=64, nodes=1024, matches=8192,
-                       matches_per_step=64, nodes_per_step=64)
     rng = random.Random(23)
     streams = {
         f"k{i}": stock_stream(rng, batch * n_batches) for i in range(n_keys)
@@ -1138,15 +1282,41 @@ def bench_sink_bytes() -> Dict[str, Any]:
         for b in range(n_batches)
     ]
     ref = {"json": sequence_to_json_bytes, "arrow": sequence_to_arrow_ipc}
+    sink_query = compile_query(
+        compile_pattern(stock_pattern()), stock_schema()
+    )
+    cfg = EngineConfig()
     controller_state: Dict[str, Any] = {}
+    if ARGS.autosize:
+        sizer = BatchedDeviceNFA(
+            sink_query, keys=list(streams), config=cfg, drain_mode="flat",
+            query_name="stock_rising",
+        )
+        auto = CapacityAutosizer(sizer)
+        for _ in range(AUTOSIZE_ROUNDS):
+            before = sizer.resizes
+            for chunk in chunks:
+                sizer.advance_packed(sizer.pack(chunk), decode=False)
+            sizer.drain()
+            auto.observe(events=batch * n_keys, t=batch)
+            if sizer.resizes == before:
+                break
+        cfg = sizer.config
+        controller_state = auto.state()
 
     def _run(fmt: str):
         bat = BatchedDeviceNFA(
-            compile_query(compile_pattern(stock_pattern()), stock_schema()),
+            sink_query,
             keys=list(streams), config=cfg, drain_mode="flat",
             sink_format=fmt, query_name="stock_rising",
         )
-        ctl = DrainController(bat) if fmt == "json" else None
+        # Without autosizing, keep the legacy cadence controller on the
+        # json engine so the `controller` block stays populated.
+        ctl = (
+            DrainController(bat)
+            if fmt == "json" and not ARGS.autosize
+            else None
+        )
         # Warm chunk compiles advance/post + the drain/decode path; its
         # matches still count (all three runs see identical streams).
         bat.advance_packed(bat.pack(chunks[0]), decode=False)
@@ -1272,12 +1442,16 @@ def _regression_block(
         "tunnel_degraded": tunnel_degraded,
         "platform": platform,
         "mode": _bench_mode(),
+        "autosized": bool(ARGS.autosize),
     }
     block = compare_artifacts(
         prior, cur, tolerance=ARGS.tolerance, prior_name=ARGS.compare
     )
     if block["regressed"]:
-        verdict = "EXCUSED (tunnel_degraded)" if block["excused"] else "REGRESSED"
+        # Name the ACTUAL excuse: "EXCUSED (tunnel_degraded)" used to be
+        # hardcoded even when the excusal was a platform or mode change.
+        excuse = block.get("excuse") or "excused"
+        verdict = f"EXCUSED ({excuse})" if block["excused"] else "REGRESSED"
         log(f"--compare vs {ARGS.compare}: {verdict}")
         for name, entry in block["configs"].items():
             for metric, d in entry.items():
@@ -1341,7 +1515,8 @@ def main() -> None:
         host["serde_eps"] = host_serde["eps"]
         log(f"{name}: host {host['eps']:.0f} ev/s (serde {host_serde['eps']:.0f}); device single-key")
         dev = bench_device_single(
-            wl["pattern"], wl["schema"], stream, wl["config"], batch, n_batches
+            wl["pattern"], wl["schema"], stream, wl["semantics"],
+            batch, n_batches,
         )
         log(f"{name}: device single {dev['eps']:.0f} ev/s")
         detail[name] = dict(host=host, device_single=dev)
@@ -1354,49 +1529,33 @@ def main() -> None:
         log(f"skip_any8_batched: K={n_keys} T={bb}")
         batched = bench_device_batched(
             skip_any8_pattern, None, skip_any8_stream,
-            # Sized for ZERO drop counters at K=2048 (lane/node/match):
-            # zero silent loss is part of the contract, not a footnote
-            # (PERF.md "Capacity policy"). The 16k ring absorbs the whole
-            # pass's pages, so no mid-pass host drain fires; the GC's
-            # prefix-bucketed remap keeps the big ring nearly free.
-            # nodes=2048: deferring every drain to pass-end pins the whole
-            # pass's match chains in the region at once.
+            # Semantic knobs only -- zero silent loss is still the
+            # contract (PERF.md "Capacity policy"), but the shape that
+            # delivers it is the autosizer's settle, not a hand table.
             # pin_interval: sparse-match workload (puts/key/interval <<
             # nodes), so the ID-interval pin replaces the GC page walks.
-            # Sized ZERO-drop across 21 continuous batches incl. rare
-            # population peaks (lanes 288, per-step caps 64, nodes=3072
-            # for interval retention + live chains at peaks).
-            EngineConfig(lanes=288, nodes=3072, matches=16384,
-                         matches_per_step=64, nodes_per_step=64,
-                         strict_windows=True, pin_interval=True),
+            dict(strict_windows=True, pin_interval=True),
             n_keys, bb, nb,
         )
         detail["skip_any8_batched"] = batched
         log(f"skip_any8_batched: {batched['eps']:.0f} ev/s; highcard letters")
         hc = bench_device_batched(
             letters_pattern, None, letters_stream,
-            EngineConfig(lanes=8, nodes=1024, matches=2048,
-                         matches_per_step=4, nodes_per_step=8,
-                         pin_interval=True),
+            dict(pin_interval=True),
             (ARGS.keys or (8 if quick else 4096)), bb, nb,
         )
         detail["highcard_letters_batched"] = hc
         # Config 2 deployed shape: the stock query batched over keys.
         log("stock_rising_batched")
-        # Sized for ZERO drops: stock_rising completes >1 match per event
-        # (one_or_more expansion), so the per-step caps must cover a full
-        # lane population and the ring one whole page -- auto-drain then
-        # drains every batch. Slower than a lossy config and honest
-        # (r03 silently discarded half its matches; see PERF.md).
+        # stock_rising completes >1 match per event (one_or_more
+        # expansion), the regime that used to need the biggest hand table
+        # (r03 silently discarded half its matches before it was sized;
+        # see PERF.md). Now the settle rounds grow the per-step caps AND
+        # the ring together from the warmup's drop counters -- the law's
+        # matches_per_step coupling exists exactly for this workload.
         detail["stock_rising_batched"] = bench_device_batched(
             stock_pattern, stock_schema, stock_stream,
-            # matches = 2 pages: the >1-match-per-event regime fills a
-            # 24576-slot page per advance, but true counts are ~67/key per
-            # batch -- the guard's on-device hole compaction keeps the
-            # ring live across the pass instead of a sync host drain per
-            # batch.
-            EngineConfig(lanes=512, nodes=4096, matches=49152,
-                         matches_per_step=384, nodes_per_step=384),
+            {},
             (ARGS.keys or (8 if quick else 512)), bb, nb,
         )
         # Same flagship stock shape with the native JSON sink (ISSUE 17):
@@ -1406,8 +1565,7 @@ def main() -> None:
         log("stock_rising_batched_json (native sink-to-bytes decode)")
         detail["stock_rising_batched_json"] = bench_device_batched(
             stock_pattern, stock_schema, stock_stream,
-            EngineConfig(lanes=512, nodes=4096, matches=49152,
-                         matches_per_step=384, nodes_per_step=384),
+            {},
             (ARGS.keys or (8 if quick else 512)), bb, nb,
             sink_format="json",
         )
@@ -1422,21 +1580,18 @@ def main() -> None:
         # is NOT set here: the hook only fires on non-decoding advances,
         # and this pass decodes+blocks every batch -- the pipelined
         # micro-drain shape is the smoke's _microdrain pass below.)
-        # nodes=3072 (up from the throughput
-        # config's 2048-at-T=8 needs): up to G advances' window nodes stay
-        # resident between flushes (the G-vs-pool-headroom trade, PERF.md
-        # v9), so the region must absorb a whole group's fold-back --
-        # sized for ZERO drop counters at this shape.
+        # gc_group stays an EXPLICIT lever (it is the experiment's
+        # variable, not a capacity number); the region headroom a whole
+        # group's fold-back needs (the G-vs-pool-headroom trade, PERF.md
+        # v9) is the autosizer's job now -- node drops during the settle
+        # rounds grow it.
         log("skip_any8_latency (T=8, per-batch drain, gc_group=8)")
         lat_keys = ARGS.keys or (8 if quick else 2048)
         lat_T = 4 if quick else 8
         lat_nb = 4 if quick else 24
         lat = bench_device_latency(
             skip_any8_pattern, None, skip_any8_stream,
-            EngineConfig(lanes=288, nodes=3072, matches=2048,
-                         matches_per_step=64, nodes_per_step=64,
-                         strict_windows=True, pin_interval=True,
-                         gc_group=8),
+            dict(strict_windows=True, pin_interval=True, gc_group=8),
             lat_keys, lat_T, lat_nb,
         )
         detail["skip_any8_latency"] = lat
@@ -1454,16 +1609,13 @@ def main() -> None:
             f"lag p99 {wm_pass['lag_p99_ms']:.0f} ms"
         )
         if ARGS.smoke:
-            # CI-sized config for the two smoke-only passes below: they
+            # Semantic knobs for the two smoke-only passes below: they
             # check the micro-drain CODE PATH and the GC-group CADENCE,
-            # not the flagship sizing, and the flagship planes make the
-            # drain-probe/flush compiles the whole wall on a 2-core CI
-            # box.
-            def _ci_cfg(g: int) -> EngineConfig:
-                return EngineConfig(lanes=32, nodes=512, matches=512,
-                                    matches_per_step=16, nodes_per_step=16,
-                                    strict_windows=True, pin_interval=True,
-                                    gc_group=g)
+            # not the flagship sizing -- gc_group is the swept variable,
+            # capacity settles from defaults like every other config.
+            def _ci_sem(g: int) -> Dict[str, Any]:
+                return dict(strict_windows=True, pin_interval=True,
+                            gc_group=g)
 
             # Micro-drain CI pass (satellite: the emit-latency path must
             # not be hardware-only): pipelined dispatch with NO caller
@@ -1474,7 +1626,7 @@ def main() -> None:
             log("skip_any8_latency_microdrain (pipelined, target_emit_ms=0)")
             detail["skip_any8_latency_microdrain"] = bench_device_latency(
                 skip_any8_pattern, None, skip_any8_stream,
-                _ci_cfg(4), lat_keys, lat_T, lat_nb,
+                _ci_sem(4), lat_keys, lat_T, lat_nb,
                 target_emit_ms=0.0, pipelined=True,
             )
             # GC-group amortization contract on CPU: post COMPUTE
@@ -1487,7 +1639,7 @@ def main() -> None:
             for g in (1, 2, 4):
                 r = bench_device_latency(
                     skip_any8_pattern, None, skip_any8_stream,
-                    _ci_cfg(g), lat_keys, lat_T, 12,
+                    _ci_sem(g), lat_keys, lat_T, 12,
                     profile_sync=True,
                 )
                 sweep["post_ms"][str(g)] = r["components"]["post_ms"]
@@ -1662,6 +1814,16 @@ def main() -> None:
         # mode_change excusal reads this instead of inferring from the
         # quick/schema_ok markers legacy artifacts carry.
         "mode": _bench_mode(),
+        # Zero-knob capacity (ISSUE 18): True when every config armed at
+        # EngineConfig() defaults and the autosizer settled the shapes.
+        # perf_ledger excuses deltas across a flag flip (hand-tuned vs
+        # autosized rounds measure different shapes by design).
+        "autosized": bool(ARGS.autosize),
+        # The flagship config's settle record: the autosizer's final
+        # state (capacity + nested cadence), rounds to convergence, and
+        # the warmup drops consumed as sizing signal. Per-config blocks
+        # stay under their own `configs` entries.
+        "autosize": detail.get("skip_any8_batched", {}).get("autosize"),
         # No JVM is provisionable in this zero-egress image: the baseline
         # denominators are in-process Python ports of the reference's
         # per-record NFA loop (bench_host / bench_host_serde). A JVM NFA

@@ -364,21 +364,24 @@ class SoakRun:
 
         self.driver = LogDriver(
             self._build_topology(registry), group="soak", registry=registry,
+            pacing=bool(getattr(self.args, "auto_cadence", True)),
         )
         self._arm_controllers(registry)
 
     def _arm_controllers(self, registry) -> None:
-        """Auto cadence (ISSUE 17): arm a DrainController on every
-        device-runtime scenario engine so the stall/soak cadence knobs
-        (target_emit_ms, gc_group) are tuned from the live latency
-        histogram and ring occupancy instead of static defaults. Re-armed
-        after every chaos rebuild -- a fresh driver means fresh engines;
-        the knob state each controller converged to is re-derived from
-        the same (still-live) registry signals."""
+        """Auto capacity + cadence (ISSUE 17/18): arm a CapacityAutosizer
+        on every device-runtime scenario engine. Each autosizer owns a
+        DrainController, so the stall/soak cadence knobs (target_emit_ms,
+        gc_group) keep tuning from the live latency histogram and ring
+        occupancy, and on top of that the engine's lane/node/match caps
+        self-size from the fused probe's occupancy and drop counters.
+        Re-armed after every chaos rebuild -- a fresh driver means fresh
+        engines; the knob state each controller converged to is
+        re-derived from the same (still-live) registry signals."""
         self._controllers = {}
         if not getattr(self.args, "auto_cadence", True):
             return
-        from ..parallel.drain_sched import DrainController
+        from ..parallel.drain_sched import CapacityAutosizer
 
         by_query = {sc.query: sc.name for sc in self.fleet}
         for _stream, node, _out in self.driver.topology.queries:
@@ -386,7 +389,9 @@ class SoakRun:
             name = by_query.get(getattr(node, "name", None))
             if eng is None or name is None:
                 continue
-            self._controllers[name] = DrainController(eng, registry=registry)
+            self._controllers[name] = CapacityAutosizer(
+                eng, registry=registry
+            )
 
     def _open_log(self):
         """The durable log handle pipelines use: the file-backed log, or
@@ -948,6 +953,7 @@ class SoakRun:
             reg_block = _eps_regression_block(
                 args.compare, scenario_eps, platform, args.tolerance,
                 quick=bool(args.quick),
+                autosized=bool(getattr(args, "auto_cadence", True)),
             )
             reg_ok = not reg_block["regressed"] or reg_block["excused"]
             reg_excused = reg_block["excused"]
@@ -1000,6 +1006,9 @@ class SoakRun:
                 "seed": args.seed,
                 "quick": bool(args.quick),
                 "platform": platform,
+                # Engine capacity chosen by the autosizer, not hand-tuned
+                # (perf_ledger's `autosized` excusal keys off this).
+                "autosized": bool(getattr(args, "auto_cadence", True)),
                 "runtime": args.runtime,
                 "transport": args.transport,
                 "violation": args.violation,
@@ -1033,9 +1042,10 @@ class SoakRun:
                         sc.generator.produced / wall if wall > 0 else 0.0
                     ),
                     "gated": sc.gated,
-                    # The adaptive drain controller's chosen knobs
-                    # (ISSUE 17); None for scenarios running without
-                    # auto cadence (host runtime / --no-auto-cadence).
+                    # The capacity autosizer's chosen caps + nested
+                    # cadence knobs (ISSUE 17/18); None for scenarios
+                    # running without auto cadence (host runtime /
+                    # --no-auto-cadence).
                     "controller": self._controller_state.get(sc.name),
                 }
                 for sc in self.fleet
@@ -1055,6 +1065,7 @@ def _eps_regression_block(
     platform: str,
     tolerance: float,
     quick: bool = False,
+    autosized: bool = False,
 ) -> Dict[str, Any]:
     """compare_artifacts over the soak's pseudo-configs. A prior SOAK
     artifact is folded into bench shape first (its scenarios become
@@ -1084,6 +1095,9 @@ def _eps_regression_block(
                 if (prior_doc.get("soak") or {}).get("quick")
                 else "full"
             ),
+            "autosized": bool(
+                (prior_doc.get("soak") or {}).get("autosized")
+            ),
         }
     else:
         prior = load_artifact(prior_path)
@@ -1092,6 +1106,7 @@ def _eps_regression_block(
         "tunnel_degraded": False,
         "platform": platform,
         "mode": "quick" if quick else "full",
+        "autosized": autosized,
     }
     return compare_artifacts(
         prior, cur, tolerance=tolerance, prior_name=prior_path,
@@ -1196,11 +1211,13 @@ def build_parser() -> argparse.ArgumentParser:
                     "'drops' forces reorder-overflow record loss")
     ap.add_argument("--auto-cadence", default=True,
                     action=argparse.BooleanOptionalAction,
-                    help="arm the adaptive drain controller "
+                    help="arm the capacity autosizer + drain controller "
                     "(parallel/drain_sched.py) on every device-runtime "
-                    "scenario engine: emit cadence and GC grouping are "
-                    "tuned from the live latency histogram and ring "
-                    "occupancy instead of static defaults; the chosen "
+                    "scenario engine, and adaptive ingest pacing on the "
+                    "driver: emit cadence, GC grouping AND the "
+                    "lane/node/match caps are tuned from the live "
+                    "latency histogram, ring occupancy and drop "
+                    "counters instead of static defaults; the chosen "
                     "knobs land in the verdict's scenario blocks")
     return ap
 

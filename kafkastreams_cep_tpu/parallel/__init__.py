@@ -1,7 +1,7 @@
 """Key-axis parallelism: vmapped multi-key engine + mesh sharding."""
 
 from .batched import BatchedDeviceNFA
-from .drain_sched import DrainController
+from .drain_sched import AdmissionPacer, CapacityAutosizer, DrainController
 from .stacked import StackedQueryEngine
 from .key_shard import (
     KEY_AXIS,
@@ -19,7 +19,9 @@ from .key_shard import (
 )
 
 __all__ = [
+    "AdmissionPacer",
     "BatchedDeviceNFA",
+    "CapacityAutosizer",
     "DrainController",
     "StackedQueryEngine",
     "KEY_AXIS",

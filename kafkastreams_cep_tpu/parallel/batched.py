@@ -250,6 +250,11 @@ class BatchedDeviceNFA:
         self._pos_probes: deque = deque()
         #: (accum_at_obs, pos, region_fill) from the freshest probe.
         self._pos_obs: Optional[Tuple[int, int, int]] = None
+        #: Freshest probed max live-run count per key (None before any
+        #: probe lands) -- the autosizer's lane-cap signal.
+        self.lane_obs: Optional[int] = None
+        #: In-place capacity re-shapes performed (resize()).
+        self.resizes = 0
         self._drain_epoch = 0
         self._pos_max_fn = None
         self._shard_stats_fn = None
@@ -441,6 +446,17 @@ class BatchedDeviceNFA:
             "cep_region_fill", "Freshest probed max node-region fill",
             labels=("instance",),
         ).labels(instance=inst)
+        self._m_lane_occupancy = r.gauge(
+            "cep_lane_occupancy",
+            "Freshest probed max live-run count per key (the capacity "
+            "autosizer's lane-cap signal; rides the async ring probe)",
+            labels=("instance",),
+        ).labels(instance=inst)
+        self._m_resizes = r.counter(
+            "cep_engine_resizes_total",
+            "In-place capacity re-shapes (graft restores at a new "
+            "lane/node/match extent; each one retraces the advance)",
+        )
         self._m_pending = r.gauge(
             "cep_pending_matches", "Pending matches at the last drain probe",
             labels=("instance",),
@@ -1475,6 +1491,45 @@ class BatchedDeviceNFA:
         tree = decode_array_tree(r.blob())
         pool_tree = decode_array_tree(r.blob())
         upgrade_checkpoint_trees(tree, pool_tree)
+        # Cross-shape restore (ISSUE 18): a snapshot taken at a different
+        # capacity grafts into the target config's shape -- or refuses
+        # loudly (ShapeRestoreError) when its LIVE occupancy does not
+        # fit. Shapes are compared on the capacity axes only (everything
+        # but the trailing key axis; key-extent deltas are handled by the
+        # granularity grow below).
+        k_snap = int(tree["active"].shape[-1])
+        mismatch = any(
+            name in tree
+            and tuple(np.asarray(tree[name]).shape[:-1]) != tuple(v.shape[:-1])
+            for name, v in bat.state.items()
+        ) or any(
+            name in pool_tree
+            and tuple(np.asarray(pool_tree[name]).shape[:-1])
+            != tuple(v.shape[:-1])
+            for name, v in bat.pool.items()
+        )
+        if mismatch:
+            from ..state.serde import check_restore_capacity, graft_array_tree
+
+            check_restore_capacity(
+                tree, pool_tree, lanes=bat.config.lanes,
+                nodes=bat.config.nodes, matches=bat.config.matches,
+                where="BatchedDeviceNFA.restore",
+            )
+            tgt_s = {
+                name: np.array(np.asarray(v))
+                for name, v in init_batched_state(
+                    bat.query, bat.config, k_snap
+                ).items()
+            }
+            tgt_p = {
+                name: np.array(np.asarray(v))
+                for name, v in init_batched_pool(
+                    bat.query, bat.config, k_snap
+                ).items()
+            }
+            tree = graft_array_tree(tree, tgt_s)
+            pool_tree = graft_array_tree(pool_tree, tgt_p)
         state = {k: jnp.asarray(v) for k, v in tree.items()}
         pool = {k: jnp.asarray(v) for k, v in pool_tree.items()}
         if mesh is not None:
@@ -1523,6 +1578,153 @@ class BatchedDeviceNFA:
             ).astype(np.int64)
         return bat
 
+    #: Config fields whose change forces a re-init (compile signatures).
+    _SHAPE_FIELDS = (
+        "lanes", "nodes", "matches", "matches_per_step", "nodes_per_step",
+    )
+
+    def resize(self, config: EngineConfig) -> bool:
+        """Re-shape the capacity caps IN PLACE: flush -> capacity check ->
+        re-init at the new shape -> graft restore (state/serde.py), never
+        touching the key axis or the stream position.
+
+        Grow-or-shrink: the graft pastes the compacted live prefixes
+        (GC folds live nodes to `[0, node_count)`, the pend ring is a
+        dense prefix) into freshly initialized trees, so pads keep init
+        values and a later grow-back is bitwise-identical to never having
+        shrunk. A shrink that would cut LIVE state refuses loudly with
+        `ShapeRestoreError` (serde.check_restore_capacity) -- callers
+        (the CapacityAutosizer) treat that as "not now", not an error.
+
+        Every resize retraces the advance/append/flush signatures, so
+        callers must budget it (CompileWatch counts stay the backstop).
+        Returns True when a re-shape actually happened."""
+        old = self.config
+        if all(
+            getattr(config, f) == getattr(old, f) for f in self._SHAPE_FIELDS
+        ):
+            self.config = config
+            return False
+        from ..state.serde import check_restore_capacity, graft_array_tree
+
+        # Node ids are only region-stable through the group fold; the
+        # flush also empties the window so no ys carry the old extent.
+        self._flush_group()
+        state_np = {k: np.asarray(v) for k, v in self.state.items()}
+        pool_np = {k: np.asarray(v) for k, v in self.pool.items()}
+        check_restore_capacity(
+            state_np, pool_np, lanes=config.lanes, nodes=config.nodes,
+            matches=config.matches, where="resize",
+        )
+        snap_np = None
+        if self._snap is not None:
+            snap_np = (
+                {k: np.asarray(v) for k, v in self._snap[0].items()},
+                {k: np.asarray(v) for k, v in self._snap[1].items()},
+            )
+            # The replay interval replays from this generation: it must
+            # fit the new shape too or a collision replay would truncate.
+            check_restore_capacity(
+                snap_np[0], snap_np[1], lanes=config.lanes,
+                nodes=config.nodes, matches=config.matches,
+                where="resize (replay snapshot)",
+            )
+
+        def _graft(src_state, src_pool):
+            tgt_s = {
+                k: np.array(np.asarray(v))
+                for k, v in init_batched_state(
+                    self.query, config, self.K_padded
+                ).items()
+            }
+            tgt_p = {
+                k: np.array(np.asarray(v))
+                for k, v in init_batched_pool(
+                    self.query, config, self.K_padded
+                ).items()
+            }
+            graft_array_tree(src_state, tgt_s)
+            graft_array_tree(src_pool, tgt_p)
+            s = {k: jnp.asarray(v) for k, v in tgt_s.items()}
+            p = {k: jnp.asarray(v) for k, v in tgt_p.items()}
+            if self.mesh is not None:
+                s = shard_state(s, self.mesh)
+                p = shard_state(p, self.mesh)
+            return s, p
+
+        self.state, self.pool = _graft(state_np, pool_np)
+        if snap_np is not None:
+            self._snap = _graft(*snap_np)
+        self.config = config
+        # Re-resolve the engine for the new shape: a forced pallas engine
+        # must still fit the kernel envelope; an auto-picked one falls
+        # back to the XLA step exactly like a first-use kernel failure.
+        if self.engine.startswith("pallas"):
+            from ..ops.pallas_step import supports_pallas
+
+            reason = supports_pallas(self.query, config)
+            if reason is not None and not self._engine_auto:
+                raise ValueError(f"pallas engine unsupported: {reason}")
+            if reason is not None:
+                self._m_info.labels(
+                    instance=self.instance_id,
+                    engine=self.engine, drain_mode=self.drain_mode,
+                ).set(0)
+                self.engine = "xla"
+                self.engine_fallback_reason = (
+                    f"resize left the pallas envelope: {reason}"[:300]
+                )
+                self._m_fallback.labels(
+                    instance=self.instance_id,
+                    reason=self.engine_fallback_reason,
+                ).set(1)
+                self._m_info.labels(
+                    instance=self.instance_id,
+                    engine=self.engine, drain_mode=self.drain_mode,
+                ).set(1)
+        if self.engine.startswith("pallas"):
+            from ..ops.pallas_step import (
+                build_pallas_batched_advance,
+                build_pallas_batched_append,
+                build_pallas_batched_flush,
+            )
+
+            self._advance = build_pallas_batched_advance(
+                self.query, config,
+                interpret=(self.engine == "pallas_interpret"),
+                mesh=self.mesh,
+            )
+            self._append = build_pallas_batched_append(config, mesh=self.mesh)
+            self._flush = build_pallas_batched_flush(
+                self.query, config, mesh=self.mesh
+            )
+        else:
+            self._advance = build_batched_advance(self.query, config)
+            self._append = build_batched_append(config)
+            self._flush = build_batched_flush(self.query, config)
+        self._advance = self._wrap_compiled(self._advance, "advance")
+        self._append = self._wrap_compiled(self._append, "append")
+        self._flush = self._wrap_compiled(self._flush, "flush")
+        # Every shape-baked cache re-traces lazily at the new extent.
+        self._pos_max_fn = None
+        self._shard_stats_fn = None
+        self._drain_compact_fn = None
+        self._drain_counts_fn = None
+        self._compact_pend_fn = None
+        self._drain_probe_fn = None
+        self._flatten_fns = {}
+        self._stats_fn = None
+        self._drop_check_fn = None
+        # In-flight probes reference the old arrays: epoch-invalidate them
+        # (the worst-case accumulator stays valid -- ring content is
+        # grafted, not drained).
+        self._drain_epoch += 1
+        self._pos_obs = None
+        self.lane_obs = None
+        self.resizes += 1
+        self._m_resizes.inc()
+        return True
+
     # ------------------------------------------------------------ internals
     def _native_packer(self):
         """The C packer module, or None (cached; dtype-gated)."""
@@ -1552,11 +1754,22 @@ class BatchedDeviceNFA:
         if self._pos_max_fn is None:
             self._pos_max_fn = self._wrap_compiled(
                 jax.jit(
-                    lambda pos, nc: jnp.stack([jnp.max(pos), jnp.max(nc)])
+                    lambda pos, nc, act: jnp.stack([
+                        jnp.max(pos),
+                        jnp.max(nc),
+                        # Max live-run count per key: the lane-cap signal
+                        # the capacity autosizer shrinks/grows against --
+                        # fused into the same async probe, zero extra
+                        # dispatches.
+                        jnp.max(jnp.sum(act.astype(jnp.int32), axis=0)),
+                    ])
                 ),
                 "pos_probe",
             )
-        arr = self._pos_max_fn(self.pool["pend_pos"], self.pool["node_count"])
+        arr = self._pos_max_fn(
+            self.pool["pend_pos"], self.pool["node_count"],
+            self.state["active"],
+        )
         try:
             arr.copy_to_host_async()
         except Exception:
@@ -1591,6 +1804,9 @@ class BatchedDeviceNFA:
                 # landed -- no extra sync.
                 self._m_pend_occupancy.set(int(vals[0]))
                 self._m_region_fill.set(int(vals[1]))
+                if vals.shape[0] > 2:
+                    self.lane_obs = int(vals[2])
+                    self._m_lane_occupancy.set(int(vals[2]))
                 if int(vals[0]) > 0:
                     # A real match landed: re-arm the region-pressure
                     # trigger (see advance_packed's backoff).

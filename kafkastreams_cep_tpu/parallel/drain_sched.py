@@ -46,7 +46,7 @@ from __future__ import annotations
 import time as _time
 from typing import Any, Dict, Optional
 
-__all__ = ["DrainController"]
+__all__ = ["AdmissionPacer", "CapacityAutosizer", "DrainController"]
 
 
 def _pow2_down(n: int) -> int:
@@ -55,6 +55,13 @@ def _pow2_down(n: int) -> int:
 
 def _pow2_up(n: int) -> int:
     return max(2, n * 2)
+
+
+def _pow2_at_least(n: int) -> int:
+    """Smallest power of two >= n (>= 1). Shared quantizer: every
+    adaptive extent moves on the pow2 lattice so the set of distinct
+    compile signatures a run can visit stays logarithmic."""
+    return 1 << max(0, int(n) - 1).bit_length()
 
 
 class DrainController:
@@ -267,4 +274,422 @@ class DrainController:
             "gc_changes": self._gc_changes,
             "compile_budget": self.compile_budget,
             "compiles_seen": None if cw is None else cw.seen_count,
+        }
+
+
+#: Drop-counter family -> the EngineConfig axis whose cap it exhausts.
+_DROP_AXIS = {
+    "lane_drops": "lanes",
+    "node_drops": "nodes",
+    "match_drops": "matches",
+}
+
+
+class CapacityAutosizer:
+    """Zero-knob capacity control for one `BatchedDeviceNFA` (ISSUE 18).
+
+    Composes a `DrainController` (cadence knobs: emit budget, gc_group,
+    advisory T) and adds the CAPACITY law on top: the lane/node/match
+    caps auto-grow and auto-shrink from the same sync-free signals --
+    the fused probe's ring occupancy / region fill, the piggybacked
+    lane-occupancy probe, and the `cep_overflow_dropped_total{counter}`
+    deltas the engine latches at drain boundaries. A move is a single
+    `engine.resize()` (snapshot -> re-init -> graft restore), so every
+    step retraces the advance: steps are pow2-quantized, budgeted
+    (`compile_budget`), cooled down and hysteretic exactly like the
+    drain controller's gc_group law -- steady state is compile-flat
+    (analysis/jit_audit.py stays the red test).
+
+    Law per axis:
+
+      * GROW (reactive): a nonzero drop delta doubles the exhausted axis
+        immediately -- drops are loss, budget or not (the resize still
+        counts against the budget; a budget raised this way means the
+        workload genuinely outgrew the window, which the artifact makes
+        visible via `resizes`). A match drop can come from the pend ring
+        OR the per-(key,step) emission cap, and the counter cannot tell
+        them apart, so a match drop doubles `matches_per_step` alongside
+        `matches` (capped at the ring size): the wrong cap growing once
+        is cheap, staying lossy is not.
+      * GROW (proactive): occupancy above `grow_frac` of the cap doubles
+        the axis before drops start, charged to the budget + cooldown.
+      * SHRINK: occupancy below `shrink_frac` of the cap for
+        `shrink_patience` consecutive ticks halves the axis, floored at
+        the config the engine was armed with (the autosizer only gives
+        back what it grew -- or what the caller over-provisioned above
+        its own starting point, never below it). A shrink the engine
+        refuses (`ShapeRestoreError`: live state would not fit) resets
+        the patience and is counted, not raised.
+
+    `ensure_page(t)` is the admission guarantee: before a caller drives
+    a [T, K] batch it grows `matches` so one advance can never overflow
+    the pend ring (T * matches_per_step <= matches) -- correctness
+    bypasses the cooldown but still lands in the budget accounting.
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        *,
+        registry: Optional[Any] = None,
+        compile_budget: int = 6,
+        cooldown: int = 16,
+        grow_frac: float = 0.75,
+        shrink_frac: float = 0.15,
+        shrink_patience: int = 64,
+        max_lanes: int = 4096,
+        max_nodes: int = 1 << 20,
+        max_matches: int = 1 << 20,
+        cadence: Optional[DrainController] = None,
+        **cadence_opts: Any,
+    ) -> None:
+        self.engine = engine
+        self.query = getattr(engine, "query_name", None) or "q"
+        self.metrics = registry if registry is not None else engine.metrics
+        self.cadence = (
+            cadence
+            if cadence is not None
+            else DrainController(
+                engine, registry=self.metrics, **cadence_opts
+            )
+        )
+        self.compile_budget = int(compile_budget)
+        self.cooldown = max(1, int(cooldown))
+        self.grow_frac = float(grow_frac)
+        self.shrink_frac = float(shrink_frac)
+        self.shrink_patience = max(1, int(shrink_patience))
+        self.max_lanes = int(max_lanes)
+        self.max_nodes = int(max_nodes)
+        self.max_matches = int(max_matches)
+        cfg = engine.config
+        #: Shrink floor: the shape the engine was armed with.
+        self.floor = {
+            "lanes": int(cfg.lanes),
+            "nodes": int(cfg.nodes),
+            "matches": int(cfg.matches),
+        }
+        self._ceil = {
+            "lanes": self.max_lanes,
+            "nodes": self.max_nodes,
+            "matches": self.max_matches,
+        }
+        self.resizes = 0
+        self.refused = 0
+        self._ticks = 0
+        self._last_resize_tick = -self.cooldown
+        self._low_ticks = {"lanes": 0, "nodes": 0, "matches": 0}
+        self._drop_seen: Dict[str, float] = {}
+        lab = dict(query=self.query)
+        self._m_lanes = self.metrics.gauge(
+            "cep_autosize_lanes",
+            "Lane cap chosen by the capacity autosizer",
+            labels=("query",),
+        ).labels(**lab)
+        self._m_nodes = self.metrics.gauge(
+            "cep_autosize_nodes",
+            "Node-region cap chosen by the capacity autosizer",
+            labels=("query",),
+        ).labels(**lab)
+        self._m_matches = self.metrics.gauge(
+            "cep_autosize_matches",
+            "Pend-ring cap chosen by the capacity autosizer",
+            labels=("query",),
+        ).labels(**lab)
+        self._m_t = self.metrics.gauge(
+            "cep_autosize_t",
+            "Pow2-quantized packed-batch extent suggested by the "
+            "autosizer (DrainController.suggest_t folded into the "
+            "capacity law)",
+            labels=("query",),
+        ).labels(**lab)
+        self._m_resize = self.metrics.counter(
+            "cep_autosize_resizes_total",
+            "Capacity re-shapes by the autosizer (axis x direction; "
+            "'refused' counts shrinks the engine declined because live "
+            "state would not fit)",
+            labels=("query", "axis", "direction"),
+        )
+        self._set_gauges()
+
+    def _set_gauges(self) -> None:
+        cfg = self.engine.config
+        self._m_lanes.set(float(cfg.lanes))
+        self._m_nodes.set(float(cfg.nodes))
+        self._m_matches.set(float(cfg.matches))
+
+    # -------------------------------------------------------------- signals
+    def _drop_deltas(self) -> Dict[str, float]:
+        """Per-axis NEW drops since the last tick, from the registry's
+        `cep_overflow_dropped_total{counter}` family (latched by the
+        engine at drain boundaries -- host-side reads only)."""
+        fam = self.metrics.get("cep_overflow_dropped_total")
+        out: Dict[str, float] = {}
+        if fam is None:
+            return out
+        for lvals, child in fam._sorted_children():
+            counter = dict(zip(fam.label_names, lvals)).get("counter")
+            axis = _DROP_AXIS.get(counter or "")
+            if axis is None:
+                continue
+            seen = self._drop_seen.get(counter, 0.0)
+            if child.value > seen:
+                out[axis] = out.get(axis, 0.0) + (child.value - seen)
+            self._drop_seen[counter] = child.value
+        return out
+
+    # -------------------------------------------------------------- control
+    def observe(self, events: int = 0, t: Optional[int] = None) -> Dict[str, Any]:
+        """One control tick: cadence knobs first (DrainController), then
+        the capacity law. Pass `t` when the caller owns its batch extent
+        so the admission guarantee (`ensure_page`) rides the tick."""
+        self._ticks += 1
+        self.cadence.observe(events)
+        if t is not None:
+            self.ensure_page(int(t))
+        cfg = self.engine.config
+        drops = self._drop_deltas()
+        occ, fill, _pos = self.engine._occupancy_bound()
+        lane_obs = getattr(self.engine, "lane_obs", None)
+        levels = {
+            "lanes": None if lane_obs is None else lane_obs / max(1, cfg.lanes),
+            "nodes": fill / max(1, cfg.nodes),
+            "matches": occ / max(1, cfg.matches),
+        }
+        want = {
+            "lanes": int(cfg.lanes),
+            "nodes": int(cfg.nodes),
+            "matches": int(cfg.matches),
+        }
+        step_want = int(cfg.matches_per_step)
+        grew = False
+        for axis in ("lanes", "nodes", "matches"):
+            if drops.get(axis):
+                # Loss already happened: double now, budget notwithstanding.
+                want[axis] = min(self._ceil[axis], _pow2_up(want[axis]))
+                grew = grew or want[axis] != getattr(cfg, axis)
+        if drops.get("matches"):
+            # Per-step-cap drops cannot be cured by ring growth alone
+            # (class docstring): double the emission cap too, bounded by
+            # the (already doubled) ring so one step can never overfill.
+            step_want = min(want["matches"], _pow2_up(step_want))
+            if t is not None:
+                # Keep the admission guarantee (t * matches_per_step <=
+                # matches) true for the NEW per-step cap in the same
+                # retrace, instead of waiting for ring drops to re-teach
+                # it one doubling per tick.
+                want["matches"] = min(
+                    self._ceil["matches"],
+                    max(
+                        want["matches"],
+                        _pow2_at_least(max(1, int(t)) * step_want),
+                    ),
+                )
+                step_want = min(want["matches"], step_want)
+        budget_open = self.resizes < self.compile_budget
+        cooled = self._ticks - self._last_resize_tick >= self.cooldown
+        if budget_open and cooled:
+            for axis in ("lanes", "nodes", "matches"):
+                lvl = levels[axis]
+                if lvl is not None and lvl > self.grow_frac:
+                    want[axis] = min(self._ceil[axis], _pow2_up(want[axis]))
+        # Shrink only when nothing wants to grow this tick (hysteresis:
+        # mixed signals freeze the shape).
+        wants_grow = any(
+            want[a] > getattr(cfg, a) for a in ("lanes", "nodes", "matches")
+        )
+        if not wants_grow and budget_open and cooled:
+            for axis in ("lanes", "nodes", "matches"):
+                lvl = levels[axis]
+                if lvl is not None and lvl < self.shrink_frac:
+                    self._low_ticks[axis] += 1
+                else:
+                    self._low_ticks[axis] = 0
+                if (
+                    self._low_ticks[axis] >= self.shrink_patience
+                    and want[axis] > self.floor[axis]
+                ):
+                    want[axis] = max(self.floor[axis], _pow2_down(want[axis]))
+        self._apply(want, step=step_want)
+        t_sug = self.suggest_t()
+        self._m_t.set(float(t_sug))
+        return self.state()
+
+    def ensure_page(self, t: int) -> None:
+        """Grow `matches` so one [t, K] advance can never overflow the
+        pend ring (the loss-free admission requirement: t *
+        matches_per_step <= matches). Correctness bypasses the cooldown;
+        the resize still counts toward the budget accounting."""
+        cfg = self.engine.config
+        step_cap = max(1, int(t)) * max(1, int(cfg.matches_per_step))
+        if step_cap <= cfg.matches:
+            return
+        want = {
+            "lanes": int(cfg.lanes),
+            "nodes": int(cfg.nodes),
+            "matches": min(
+                self._ceil["matches"],
+                max(_pow2_at_least(step_cap), int(cfg.matches)),
+            ),
+        }
+        self._apply(want)
+
+    def _apply(
+        self, want: Dict[str, int], step: Optional[int] = None
+    ) -> None:
+        from dataclasses import replace
+
+        cfg = self.engine.config
+        new_step = int(cfg.matches_per_step) if step is None else int(step)
+        moves = [
+            (axis, getattr(cfg, axis), want[axis])
+            for axis in ("lanes", "nodes", "matches")
+            if want[axis] != getattr(cfg, axis)
+        ]
+        if new_step != cfg.matches_per_step:
+            moves.append(
+                ("matches_per_step", int(cfg.matches_per_step), new_step)
+            )
+        if not moves:
+            return
+        new_cfg = replace(
+            cfg, lanes=want["lanes"], nodes=want["nodes"],
+            matches=want["matches"], matches_per_step=new_step,
+        )
+        try:
+            resized = self.engine.resize(new_cfg)
+        except Exception as exc:
+            # A refused shrink (live state would not fit) is "not now",
+            # not an error; re-observe from scratch next window.
+            from ..state.serde import ShapeRestoreError
+
+            if not isinstance(exc, ShapeRestoreError):
+                raise
+            self.refused += 1
+            for axis, _old, _new in moves:
+                self._low_ticks[axis] = 0
+                self._m_resize.labels(
+                    query=self.query, axis=axis, direction="refused"
+                ).inc()
+            return
+        if not resized:
+            return
+        self.resizes += 1
+        self._last_resize_tick = self._ticks
+        for axis, old, new in moves:
+            self._low_ticks[axis] = 0
+            self._m_resize.labels(
+                query=self.query, axis=axis,
+                direction="grow" if new > old else "shrink",
+            ).inc()
+        self._set_gauges()
+
+    def suggest_t(self) -> int:
+        """The cadence controller's advisory batch extent, pow2-quantized
+        so callers that adopt it visit a logarithmic set of [T, K]
+        compile signatures."""
+        return min(
+            self.cadence.t_max,
+            max(self.cadence.t_min, _pow2_at_least(self.cadence.suggest_t())),
+        )
+
+    def state(self) -> Dict[str, Any]:
+        """JSON-ready snapshot for artifacts: the chosen capacity plus
+        the nested cadence state. The `resizes` key doubles as the
+        schema discriminator (check_bench_schema dispatches autosizer
+        vs plain drain-controller blocks on it)."""
+        cfg = self.engine.config
+        cw = getattr(self.engine, "compile_watch", None)
+        return {
+            "lanes": int(cfg.lanes),
+            "nodes": int(cfg.nodes),
+            "matches": int(cfg.matches),
+            "matches_per_step": int(cfg.matches_per_step),
+            "suggest_t": self.suggest_t(),
+            "resizes": self.resizes,
+            "refused": self.refused,
+            "ticks": self._ticks,
+            "compile_budget": self.compile_budget,
+            "floor": dict(self.floor),
+            "cadence": self.cadence.state(),
+            "compiles_seen": None if cw is None else cw.seen_count,
+        }
+
+
+class AdmissionPacer:
+    """Adaptive ingest pacing for poll loops (ISSUE 18).
+
+    SOAK_r01's stall query showed the failure mode: a fixed (or
+    unbounded) poll budget lets one backlogged topic starve the gated
+    queries' event-time ticks, so p99 match latency becomes
+    ingest-rate-bound. The pacer sizes each poll's record budget around
+    the measured admission rate -- one poll should cost about
+    `target_poll_ms` of processing, keeping `tick_event_time`/`flush`
+    cadence bounded no matter the backlog. Pow2-quantized and clamped,
+    host-side arithmetic only.
+    """
+
+    def __init__(
+        self,
+        *,
+        target_poll_ms: float = 100.0,
+        min_batch: int = 32,
+        max_batch: int = 8192,
+        registry: Optional[Any] = None,
+        group: str = "default",
+    ) -> None:
+        if target_poll_ms <= 0:
+            raise ValueError(
+                f"target_poll_ms must be > 0, got {target_poll_ms}"
+            )
+        if not 0 < int(min_batch) <= int(max_batch):
+            raise ValueError(
+                f"need 0 < min_batch <= max_batch, got "
+                f"({min_batch}, {max_batch})"
+            )
+        self.target_poll_ms = float(target_poll_ms)
+        self.min_batch = int(min_batch)
+        self.max_batch = int(max_batch)
+        self._rate_ev_s = 0.0
+        self._t = _time.perf_counter()
+        self._m_batch = None
+        if registry is not None:
+            self._m_batch = registry.gauge(
+                "cep_driver_poll_batch",
+                "Per-poll record budget chosen by the admission pacer",
+                labels=("group",),
+            ).labels(group=group)
+
+    def observe(self, admitted: int) -> None:
+        """Fold one completed poll's admitted-record count into the rate
+        EWMA (same 0.8/0.2 blend as the drain controller)."""
+        now = _time.perf_counter()
+        dt = now - self._t
+        self._t = now
+        if admitted > 0 and dt > 0:
+            inst = admitted / dt
+            self._rate_ev_s = (
+                inst if self._rate_ev_s == 0.0
+                else 0.8 * self._rate_ev_s + 0.2 * inst
+            )
+
+    def suggest_batch(self) -> int:
+        """The next poll's record budget: about `target_poll_ms` worth of
+        records at the observed admission rate, pow2-quantized into
+        [min_batch, max_batch]."""
+        if self._rate_ev_s <= 0:
+            n = self.min_batch
+        else:
+            n = _pow2_at_least(
+                int(self._rate_ev_s * self.target_poll_ms / 1e3)
+            )
+        n = max(self.min_batch, min(self.max_batch, n))
+        if self._m_batch is not None:
+            self._m_batch.set(float(n))
+        return n
+
+    def state(self) -> Dict[str, Any]:
+        return {
+            "rate_ev_s": self._rate_ev_s,
+            "batch": self.suggest_batch(),
+            "target_poll_ms": self.target_poll_ms,
         }

@@ -590,6 +590,90 @@ def decode_array_tree(data: bytes) -> Dict[str, np.ndarray]:
     return out
 
 
+class ShapeRestoreError(CheckpointError):
+    """Cross-shape restore refused: the snapshot's LIVE occupancy does
+    not fit the target shape. Raised instead of silently truncating --
+    dropping live lanes/nodes/pending matches on restore would be
+    silent state loss dressed up as a resize."""
+
+
+def check_restore_capacity(
+    state: Dict[str, Any],
+    pool: Dict[str, Any],
+    *,
+    lanes: int,
+    nodes: int,
+    matches: int,
+    where: str = "restore",
+) -> None:
+    """Refuse loudly when a snapshot's live occupancy exceeds the target
+    capacity (`ShapeRestoreError`). The checks lean on the engine's
+    compaction invariants: GC folds live nodes to the region prefix
+    `[0, node_count)` and the pend ring is a dense prefix
+    `[0, pend_pos)`, so prefix extents bound every live id."""
+    problems = []
+    active = np.asarray(state["active"])
+    if active.ndim >= 1 and active.shape[0] > lanes:
+        # Lanes are NOT compacted to a prefix: any live run in a lane
+        # beyond the target extent blocks the shrink.
+        lane_live = active.reshape(active.shape[0], -1).any(axis=1)
+        if bool(lane_live[lanes:].any()):
+            top = int(np.nonzero(lane_live)[0].max())
+            problems.append(f"live run in lane {top} >= target lanes {lanes}")
+    node_count = np.asarray(pool["node_count"])
+    if int(node_count.max(initial=0)) > nodes:
+        problems.append(
+            f"node_count {int(node_count.max(initial=0))} > target nodes {nodes}"
+        )
+    pend_pos = np.asarray(pool["pend_pos"])
+    if int(pend_pos.max(initial=0)) > matches:
+        problems.append(
+            f"pend_pos {int(pend_pos.max(initial=0))} > target matches {matches}"
+        )
+    # Defensive id bound: every stored node id (match chains, run
+    # cursors, predecessor links) must address the target region.
+    max_id = -1
+    for tree, name in ((state, "node"), (state, "root"),
+                       (pool, "node_pred"), (pool, "pend")):
+        arr = np.asarray(tree[name])
+        if arr.size:
+            max_id = max(max_id, int(arr.max()))
+    if max_id >= nodes:
+        problems.append(f"stored node id {max_id} >= target nodes {nodes}")
+    if problems:
+        raise ShapeRestoreError(
+            f"{where}: snapshot does not fit target shape "
+            f"(lanes={lanes}, nodes={nodes}, matches={matches}): "
+            + "; ".join(problems)
+        )
+
+
+def graft_array_tree(
+    src: Dict[str, Any], target: Dict[str, np.ndarray]
+) -> Dict[str, np.ndarray]:
+    """Paste `src` leaves into freshly initialized `target` leaves,
+    slicing every axis to the common extent (in place; returns target).
+
+    Correct for the device trees because capacity pads carry init values
+    (node planes -1, pend ring -1, pinned False) and the live content is
+    compacted to axis prefixes -- callers gate on
+    `check_restore_capacity` first so nothing live is ever cut."""
+    for name, dst in target.items():
+        if name not in src:
+            continue
+        arr = np.asarray(src[name])
+        if arr.ndim != dst.ndim:
+            raise ShapeRestoreError(
+                f"graft: leaf {name!r} rank mismatch "
+                f"({arr.ndim} vs {dst.ndim})"
+            )
+        sl = tuple(
+            slice(0, min(a, b)) for a, b in zip(arr.shape, dst.shape)
+        )
+        dst[sl] = arr[sl].astype(dst.dtype, copy=False)
+    return target
+
+
 def encode_event_registry(
     events: Dict[int, Event],
     serialize: Callable[[Any], bytes] = _default_serialize,

@@ -52,11 +52,18 @@ class QueryNode(Generic[K, V]):
     (streams/device_processor.py); matches surface when a batch fills or on
     `Topology.flush()`.
 
+    runtime="auto": zero-knob routing (streams/auto_router.py) -- the
+    query starts on the host runtime and promotes itself to the device
+    engine when the observed distinct-key count crosses the scale where
+    the batched kernel wins, replaying history so sink digests stay
+    bitwise-identical to an all-device run.
+
     Pick by key cardinality: the device engine parallelizes over record
     keys, so "tpu" wins on many-key/high-volume topics while "host" wins
     below roughly 64 concurrently active keys (per-batch kernel latency is
     unamortized there -- PERF.md). The two runtimes share stores, serdes
-    and topology wiring; switching is this one argument.
+    and topology wiring; switching is this one argument -- or let "auto"
+    measure and decide.
     """
 
     def __init__(
@@ -137,8 +144,8 @@ class QueryNode(Generic[K, V]):
                     registry=registry,
                 )
             return
-        if runtime != "host":
-            raise ValueError(f"unknown runtime {runtime!r} (host|tpu)")
+        if runtime not in ("host", "auto"):
+            raise ValueError(f"unknown runtime {runtime!r} (host|tpu|auto)")
         # Compile once; the builders share the compiled stages with the
         # processor (QueryStoreBuilders.java:50-56).
         self.store_builders = QueryStoreBuilders(name, pattern)
@@ -170,6 +177,57 @@ class QueryNode(Generic[K, V]):
             registry=registry,
             **et_opts,
         )
+        if runtime == "auto":
+            # Zero-knob routing (ISSUE 18): start on the reference-parity
+            # host runtime, promote to the device engine when the observed
+            # distinct-key count crosses the threshold where the batched
+            # kernel wins (streams/auto_router.py). Host event-time knobs
+            # translate into the device EngineConfig at promotion so both
+            # phases apply the same late/reorder policy.
+            from dataclasses import replace as _dc_replace
+
+            from ..ops.engine import EngineConfig
+            from .auto_router import AutoRoutingProcessor
+
+            auto_opts = {
+                k: device_opts.pop(k)
+                for k in ("promote_after", "buffer_max", "autosize")
+                if k in device_opts
+            }
+            dev_opts = {
+                k: v
+                for k, v in device_opts.items()
+                if k not in (
+                    "reorder_capacity", "lateness_ms", "late_policy",
+                    "reorder_overflow", "on_overflow", "watermark_gen",
+                )
+            }
+            base_cfg = dev_opts.pop("config", None) or EngineConfig()
+            et_cfg: Dict[str, Any] = {}
+            for k in ("reorder_capacity", "lateness_ms", "late_policy"):
+                if k in device_opts:
+                    et_cfg[k] = device_opts[k]
+            if "reorder_overflow" in device_opts:
+                et_cfg["on_overflow"] = device_opts["reorder_overflow"]
+            elif "on_overflow" in device_opts:
+                et_cfg["on_overflow"] = device_opts["on_overflow"]
+            if et_cfg:
+                base_cfg = _dc_replace(base_cfg, **et_cfg)
+            dev_opts["config"] = base_cfg
+            if "watermark_gen" in device_opts:
+                # A custom stateful watermark generator cannot be replayed
+                # into the device gate without re-deciding late/admit: pin
+                # the host runtime for this query's lifetime.
+                auto_opts["promote_after"] = 1 << 62
+            self.processor = AutoRoutingProcessor(
+                name,
+                pattern,
+                self.processor,
+                schema=queried.schema if queried is not None else None,
+                registry=registry,
+                device_opts=dev_opts,
+                **auto_opts,
+            )
         if log is not None and self.processor.gate is not None:
             from ..state.naming import event_time_store
 
@@ -413,6 +471,17 @@ class Topology:
         outputs: List[Record] = []
         for stream, node, out in self.queries:
             if topic not in stream.topics:
+                continue
+            if node.runtime == "auto":
+                # Auto-routed runtime: the wrapper speaks the keyed surface
+                # for both phases, so matches route per-key exactly like the
+                # gated-host and device branches (including the promotion
+                # replay, whose duplicates the emission gate absorbs).
+                keyed = node.processor.process_keyed(
+                    key, value, timestamp=timestamp, topic=topic,
+                    partition=partition, offset=offset,
+                )
+                outputs.extend(self._emit_device(node, out, keyed))
                 continue
             if (
                 node.runtime != "tpu"

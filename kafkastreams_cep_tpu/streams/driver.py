@@ -84,6 +84,7 @@ class LogDriver:
         on_poison: str = "quarantine",
         max_restore_attempts: int = 3,
         partitions: Optional[Mapping[str, Sequence[int]]] = None,
+        pacing: Any = None,
     ) -> None:
         self.topology = topology
         self.log = log if log is not None else topology.log
@@ -111,6 +112,16 @@ class LogDriver:
             if partitions is not None else None
         )
         self.metrics = registry if registry is not None else default_registry()
+        #: Adaptive ingest pacing (ISSUE 18): when armed, an unbudgeted
+        #: poll() sizes its own record budget from the measured admission
+        #: rate (AdmissionPacer) instead of draining the whole backlog --
+        #: bounding tick_event_time/flush cadence under a deep backlog.
+        #: Pass True for defaults or a configured AdmissionPacer.
+        if pacing is True:
+            from ..parallel.drain_sched import AdmissionPacer
+
+            pacing = AdmissionPacer(registry=self.metrics, group=group)
+        self.pacer = pacing if pacing else None
         # Children bound once to this driver's group (labels() locks per
         # resolution; poll() is the cadence path).
         self._m_polls = self.metrics.counter(
@@ -290,6 +301,10 @@ class LogDriver:
             raise RuntimeError("LogDriver is closed")
         processed = 0
         budget = max_records
+        if budget is None and self.pacer is not None:
+            # Paced pump: about target_poll_ms worth of records at the
+            # observed admission rate (an explicit max_records wins).
+            budget = self.pacer.suggest_batch()
         for topic in self.topology.source_topics:
             scoped = (
                 self._partition_scope.get(topic)
@@ -374,6 +389,8 @@ class LogDriver:
             self.commit()
             if _flt.ACTIVE is not None:
                 _flt.ACTIVE.fire("driver.post_commit")
+        if self.pacer is not None:
+            self.pacer.observe(processed)
         self._m_polls.inc()
         self._m_records.inc(processed)
         self._last_poll_wall = time.time()

@@ -53,6 +53,15 @@ TOP_LEVEL: Dict[str, Tuple[bool, tuple]] = {
     # ledger's mode_change excusal reads this; legacy artifacts derive
     # it from quick/schema_ok, so the key is optional.
     "mode": (False, (str,)),
+    # Zero-knob capacity (ISSUE 18): True when every config armed at
+    # EngineConfig() defaults and the autosizer settled the shapes
+    # (--no-autosize records False). Optional: legacy artifacts predate
+    # the autosizer, and perf_ledger excuses deltas across a flag flip.
+    "autosized": (False, (bool,)),
+    # The flagship config's settle record (CapacityAutosizer state +
+    # rounds + warmup drops); None when the flagship did not run or
+    # --no-autosize pinned the defaults.
+    "autosize": (False, (dict, type(None))),
     "denominator": (True, (str,)),
     "configs": (True, (dict,)),
     "metrics": (True, (dict,)),
@@ -152,6 +161,73 @@ SINK_CONTROLLER_KEYS: Dict[str, tuple] = {
     "compiles_seen": OPT_NUMBER,
 }
 
+#: CapacityAutosizer.state() (parallel/drain_sched.py, ISSUE 18): the
+#: capacity law's snapshot -- chosen caps, resize/refusal counts, the
+#: shrink floor, and the NESTED cadence state (SINK_CONTROLLER_KEYS).
+#: Controller blocks dispatch on the `resizes` key: present means
+#: autosizer, absent means a plain drain controller.
+AUTOSIZER_STATE_KEYS: Dict[str, tuple] = {
+    "lanes": NUMBER,
+    "nodes": NUMBER,
+    "matches": NUMBER,
+    "matches_per_step": NUMBER,
+    "suggest_t": NUMBER,
+    "resizes": NUMBER,
+    "refused": NUMBER,
+    "ticks": NUMBER,
+    "compile_budget": NUMBER,
+    "floor": (dict,),
+    "cadence": (dict,),
+    "compiles_seen": OPT_NUMBER,
+}
+
+#: A bench `autosize` settle record (top-level for the flagship; each
+#: batched config carries its own under configs.*.autosize).
+AUTOSIZE_BLOCK_KEYS: Dict[str, tuple] = {
+    "state": (dict,),
+    "settle_rounds": NUMBER,
+    "warmup_drops": (dict,),
+}
+AUTOSIZE_DROP_KEYS: Dict[str, tuple] = {
+    "lane_drops": NUMBER,
+    "node_drops": NUMBER,
+    "match_drops": NUMBER,
+}
+
+
+def _check_controller_block(
+    block: Optional[dict], where: str, errors: List[str]
+) -> None:
+    """A `controller` entry is either a CapacityAutosizer state (ISSUE
+    18; discriminated by its `resizes` key) or a plain DrainController
+    state -- validate whichever shape it claims, both ways."""
+    if block is None:
+        return
+    if "resizes" in block:
+        _check_flat_block(block, AUTOSIZER_STATE_KEYS, where, errors)
+        if isinstance(block.get("cadence"), dict):
+            _check_flat_block(
+                block["cadence"], SINK_CONTROLLER_KEYS,
+                f"{where}.cadence", errors,
+            )
+    else:
+        _check_flat_block(block, SINK_CONTROLLER_KEYS, where, errors)
+
+
+def _check_autosize_block(
+    block: Optional[dict], where: str, errors: List[str]
+) -> None:
+    if block is None:
+        return
+    _check_flat_block(block, AUTOSIZE_BLOCK_KEYS, where, errors)
+    if isinstance(block.get("state"), dict):
+        _check_controller_block(block["state"], f"{where}.state", errors)
+    if isinstance(block.get("warmup_drops"), dict):
+        _check_flat_block(
+            block["warmup_drops"], AUTOSIZE_DROP_KEYS,
+            f"{where}.warmup_drops", errors,
+        )
+
 #: The `observation` block (ISSUE 7): what telemetry was armed while the
 #: numbers were taken, so BENCH_r* artifacts self-describe the
 #: observation overhead. http_* keys are None outside --smoke.
@@ -205,6 +281,15 @@ REGRESSION_KEYS: Dict[str, tuple] = {
     # when a truncated wrapper carries no mode marker.
     "mode_prev": (str, type(None)),
     "mode_cur": (str, type(None)),
+    # Autosize excusal (ISSUE 18): a hand-tuned round vs a zero-knob
+    # round measures deliberately different shapes; the flag flip is an
+    # excuse, not a regression. None when a side predates the flag.
+    "autosized_prev": (bool, type(None)),
+    "autosized_cur": (bool, type(None)),
+    # Which excusal actually fired (tunnel_degraded | platform_change |
+    # mode_change | autosize_change | salvaged_artifact); None when
+    # nothing regressed or nothing excused it.
+    "excuse": (str, type(None)),
 }
 REGRESSION_METRIC_KEYS: Dict[str, tuple] = {
     "prev": NUMBER,
@@ -304,6 +389,9 @@ SOAK_RUN_KEYS: Dict[str, tuple] = {
     "broker_kills": NUMBER,
     "rebalance_partitions_moved": NUMBER,
     "rebalance_records_moved": NUMBER,
+    # Zero-knob capacity (ISSUE 18): True when the device scenarios ran
+    # under the capacity autosizer + admission pacer (--auto-cadence).
+    "autosized": (bool,),
 }
 
 #: The SLO name set -- pinned EXACTLY (a soak that silently stops gating
@@ -421,8 +509,8 @@ def validate_soak(out: Any) -> List[str]:
                     sc, SOAK_SCENARIO_KEYS, f"scenarios.{name}", errors
                 )
                 if isinstance(sc.get("controller"), dict):
-                    _check_flat_block(
-                        sc["controller"], SINK_CONTROLLER_KEYS,
+                    _check_controller_block(
+                        sc["controller"],
                         f"scenarios.{name}.controller", errors,
                     )
     if isinstance(out.get("metrics"), dict):
@@ -593,10 +681,17 @@ def validate(out: Any) -> List[str]:
         for name, cfg in configs.items():
             if not isinstance(cfg, dict):
                 errors.append(f"configs.{name}: expected object")
-            elif isinstance(cfg.get("components"), dict):
+                continue
+            if isinstance(cfg.get("components"), dict):
                 _check_components(
                     cfg["components"], f"configs.{name}.components", errors
                 )
+            if isinstance(cfg.get("autosize"), dict):
+                _check_autosize_block(
+                    cfg["autosize"], f"configs.{name}.autosize", errors
+                )
+    if isinstance(out.get("autosize"), dict):
+        _check_autosize_block(out["autosize"], "autosize", errors)
     if isinstance(out.get("metrics"), dict):
         _check_metrics_section(out["metrics"], errors)
     if isinstance(out.get("metrics_merged"), dict):
@@ -627,9 +722,8 @@ def validate(out: Any) -> List[str]:
                 sink["sink_bytes"], SINK_BYTES_KEYS, "sink.sink_bytes", errors
             )
         if isinstance(sink.get("controller"), dict):
-            _check_flat_block(
-                sink["controller"], SINK_CONTROLLER_KEYS, "sink.controller",
-                errors,
+            _check_controller_block(
+                sink["controller"], "sink.controller", errors
             )
     compile_block = out.get("compile")
     if isinstance(compile_block, dict):

@@ -22,11 +22,14 @@ into one trajectory table plus a regression verdict:
   EXCEPT when either side of the comparison is marked
   ``tunnel_degraded``, when the two rounds self-describe DIFFERENT
   platforms (a cpu round after a tpu round is an environment change,
-  not a code regression), or when the rounds ran in different bench
+  not a code regression), when the rounds ran in different bench
   MODES (full vs ``--quick``/``--smoke``: CI-sized workloads are a
-  deliberate size change, e.g. the r05->r06 CPU quick round). Noise
-  from the environment or the workload size must not fail the check;
-  such rows are reported as excused instead, with the excuse named.
+  deliberate size change, e.g. the r05->r06 CPU quick round), or when
+  the rounds differ on the ``autosized`` flag (ISSUE 18: a hand-tuned
+  round vs a zero-knob round measures deliberately different engine
+  shapes). Noise from the environment or the workload size must not
+  fail the check; such rows are reported as excused instead, with the
+  excuse named.
 
 Usage:
     python scripts/perf_ledger.py BENCH_r*.json
@@ -211,6 +214,9 @@ def salvage_configs(tail: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     m = re.search(r'"tunnel_degraded":\s*(true|false)', tail)
     if m is not None:
         top["tunnel_degraded"] = m.group(1) == "true"
+    m = re.search(r'"autosized":\s*(true|false)', tail)
+    if m is not None:
+        top["autosized"] = m.group(1) == "true"
     m = re.search(r'"tunnel_mbps":\s*(null|[0-9.eE+-]+)', tail)
     if m is not None:
         top["tunnel_mbps"] = (
@@ -252,6 +258,7 @@ def parse_artifact(doc: Any) -> Dict[str, Any]:
         return {
             "configs": configs,
             "tunnel_degraded": doc.get("tunnel_degraded"),
+            "autosized": doc.get("autosized"),
             "platform": doc.get("platform"),
             "mode": artifact_mode(doc),
             "sink_controller": ctl,
@@ -268,6 +275,7 @@ def parse_artifact(doc: Any) -> Dict[str, Any]:
             return {
                 "configs": configs,
                 "tunnel_degraded": parsed.get("tunnel_degraded"),
+                "autosized": parsed.get("autosized"),
                 "platform": parsed.get("platform"),
                 "mode": artifact_mode(parsed),
                 "sink_controller": ctl,
@@ -280,13 +288,14 @@ def parse_artifact(doc: Any) -> Dict[str, Any]:
         return {
             "configs": configs,
             "tunnel_degraded": top.get("tunnel_degraded"),
+            "autosized": top.get("autosized"),
             "platform": top.get("platform"),
             "mode": top.get("mode"),
             "salvaged": bool(configs),
             "empty": not configs,
         }
-    return {"configs": {}, "tunnel_degraded": None, "platform": None,
-            "mode": None, "salvaged": False, "empty": True}
+    return {"configs": {}, "tunnel_degraded": None, "autosized": None,
+            "platform": None, "mode": None, "salvaged": False, "empty": True}
 
 
 def load_artifact(path: str) -> Dict[str, Any]:
@@ -345,6 +354,7 @@ def build_ledger(rounds: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "round": rec["round"],
                 "path": rec.get("path"),
                 "tunnel_degraded": rec["tunnel_degraded"],
+                "autosized": rec.get("autosized"),
                 "mode": rec.get("mode"),
                 "salvaged": rec["salvaged"],
                 "empty": rec["empty"],
@@ -378,6 +388,14 @@ def mode_change(a: Optional[str], b: Optional[str]) -> bool:
     return a != b and (a in ("quick", "smoke") or b in ("quick", "smoke"))
 
 
+def autosize_change(a: Optional[bool], b: Optional[bool]) -> bool:
+    """One side explicitly autosized (zero-knob engine shapes, ISSUE 18)
+    and the other not: the rounds measured deliberately different
+    capacity configs. Two unknown/hand-tuned rounds never excuse --
+    only an explicit ``"autosized": true`` marker does."""
+    return bool(a) != bool(b) and (a is True or b is True)
+
+
 def find_regressions(
     ledger: Dict[str, Any],
     rounds: List[Dict[str, Any]],
@@ -388,7 +406,8 @@ def find_regressions(
     round is tunnel_degraded -- or the two rounds self-describe
     DIFFERENT platforms (cpu vs tpu) or DIFFERENT bench modes
     (full vs quick/smoke: a deliberate workload-size delta, not a code
-    regression) -- or either side was salvaged from a truncated tail
+    regression) or DIFFERENT autosize flags (hand-tuned vs zero-knob
+    engine shapes) -- or either side was salvaged from a truncated tail
     (the numbers survived; the run context that qualifies them did
     not: not a trustworthy comparison endpoint) -- come back with
     ``"excused": True``: reported, never failed on."""
@@ -397,6 +416,7 @@ def find_regressions(
     salvaged = [bool(rec.get("salvaged")) for rec in rounds]
     platforms = [rec.get("platform") for rec in rounds]
     modes = [rec.get("mode") for rec in rounds]
+    autosized = [rec.get("autosized") for rec in rounds]
     names = [rec["round"] for rec in rounds]
     for config, series in ledger["table"].items():
         for metric in REGRESSION_METRICS:
@@ -416,6 +436,8 @@ def find_regressions(
                             excuse = "platform_change"
                         elif mode_change(modes[prev_i], modes[i]):
                             excuse = "mode_change"
+                        elif autosize_change(autosized[prev_i], autosized[i]):
+                            excuse = "autosize_change"
                         elif salvaged[i] or salvaged[prev_i]:
                             excuse = "salvaged_artifact"
                         out.append(
@@ -458,12 +480,17 @@ def compare_artifacts(
     # the markers they carry; normalized round records already have it.
     mode_prev = prev["mode"] if "mode" in prev else artifact_mode(prev)
     mode_cur = cur["mode"] if "mode" in cur else artifact_mode(cur)
-    excused = (
-        deg_prev
-        or deg_cur
-        or platform_mismatch(plat_prev, plat_cur)
-        or mode_change(mode_prev, mode_cur)
-    )
+    auto_prev = prev.get("autosized")
+    auto_cur = cur.get("autosized")
+    excuse = None
+    if deg_prev or deg_cur:
+        excuse = "tunnel_degraded"
+    elif platform_mismatch(plat_prev, plat_cur):
+        excuse = "platform_change"
+    elif mode_change(mode_prev, mode_cur):
+        excuse = "mode_change"
+    elif autosize_change(auto_prev, auto_cur):
+        excuse = "autosize_change"
     per_config: Dict[str, Any] = {}
     regressed = False
     # A config the prior carried that the current run LACKS is reported,
@@ -504,13 +531,16 @@ def compare_artifacts(
         "configs": per_config,
         "missing_configs": missing,
         "regressed": regressed,
-        "excused": excused and regressed,
+        "excused": excuse is not None and regressed,
+        "excuse": excuse if (excuse is not None and regressed) else None,
         "tunnel_degraded_prev": deg_prev,
         "tunnel_degraded_cur": deg_cur,
         "platform_prev": plat_prev,
         "platform_cur": plat_cur,
         "mode_prev": mode_prev,
         "mode_cur": mode_cur,
+        "autosized_prev": auto_prev,
+        "autosized_cur": auto_cur,
     }
 
 
@@ -565,6 +595,8 @@ def render_table(
             tags.append("salvaged from truncated tail")
         if rec["tunnel_degraded"]:
             tags.append("tunnel_degraded")
+        if rec.get("autosized"):
+            tags.append("autosized (zero-knob shapes)")
         ctl = rec.get("sink_controller")
         if ctl:
             tags.append(

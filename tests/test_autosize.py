@@ -1,0 +1,487 @@
+"""Zero-knob adaptive capacity (ISSUE 18).
+
+Pins the tentpole contracts:
+
+  * `BatchedDeviceNFA.resize()` golden cycles -- grow -> shrink -> grow
+    back to the arm shape preserves matches (Sequence equality covers
+    events and fold values), emission-identity digests, and the final
+    state/pool trees BITWISE, on both step engines (xla +
+    pallas_interpret) and both drain modes (flat + pool); also mid
+    gc-group (G > 1) and under an armed EventTimeGate;
+  * cross-shape restore refuses loudly (`ShapeRestoreError`) when live
+    occupancy exceeds the target shape -- never silent truncation --
+    and the `CapacityAutosizer` converts a refused shrink into a
+    counted no-op, not a crash;
+  * the autosizer control law: drop-reactive doubling (a match drop
+    doubles `matches_per_step` alongside `matches` -- the counter
+    cannot tell ring pressure from the per-step emission cap),
+    `ensure_page`'s admission guarantee, proactive grow behind the
+    budget, patience shrink floored at the arm config, and
+    `suggest_t()` riding the cadence controller (satellite 1: no dead
+    public API);
+  * `AdmissionPacer` pow2 pacing; `runtime="auto"` routing (host below
+    the key threshold, promote on growth, digests identical to
+    all-device);
+  * the artifact plumbing both ways: `check_bench_schema` accepts the
+    `autosize` block and `perf_ledger` excuses cross-`autosized`
+    comparisons as `autosize_change`.
+"""
+import hashlib
+import math
+import os
+import random
+import sys
+from dataclasses import replace
+
+import pytest
+
+from kafkastreams_cep_tpu import Event, QueryBuilder, compile_pattern
+from kafkastreams_cep_tpu.obs.registry import MetricsRegistry
+from kafkastreams_cep_tpu.ops.engine import EngineConfig
+from kafkastreams_cep_tpu.parallel import (
+    AdmissionPacer,
+    BatchedDeviceNFA,
+    CapacityAutosizer,
+)
+from kafkastreams_cep_tpu.pattern.expressions import value
+from kafkastreams_cep_tpu.state.serde import ShapeRestoreError
+from kafkastreams_cep_tpu.streams.emission import (
+    identity_prefix,
+    sequence_ident_frames,
+)
+
+from test_gc_groups import (
+    assert_trees_equal,
+    branching_fold_pattern,
+    letter_stream,
+)
+
+TS = 1_000_000
+
+#: The arm shape every golden test starts from and returns to.
+C0 = dict(lanes=32, nodes=256, matches=128, matches_per_step=32)
+
+
+def emission_digests(got):
+    """blake2b-16 emission-identity digests, the exactly-once currency
+    (streams/emission.py): bitwise equality here is the contract the
+    resize must not disturb."""
+    out = []
+    for key in sorted(got):
+        for seq in got[key]:
+            h = hashlib.blake2b(digest_size=16)
+            h.update(identity_prefix("q", key))
+            h.update(sequence_ident_frames(seq))
+            out.append(h.hexdigest())
+    return sorted(out)
+
+
+def drive_resized(streams, resize_at, *, engine="xla", drain_mode="flat",
+                  gc_group=1, T=4, config_kw=C0):
+    """Advance T-event batches with deferred decode, draining after every
+    batch; `resize_at` maps batch index -> EngineConfig replace kwargs
+    applied AFTER that batch's drain. Returns (matches, engine)."""
+    keys = list(streams)
+    config = EngineConfig(gc_group=gc_group, **config_kw)
+    bat = BatchedDeviceNFA(
+        compile_pattern(branching_fold_pattern()), keys=keys, config=config,
+        engine=engine, drain_mode=drain_mode,
+    )
+    got = {k: [] for k in keys}
+    n = max(len(s) for s in streams.values())
+    for b in range(math.ceil(n / T)):
+        chunk = {
+            k: s[b * T: (b + 1) * T]
+            for k, s in streams.items()
+            if s[b * T: (b + 1) * T]
+        }
+        bat.advance_packed(bat.pack(chunk), decode=False)
+        for k, seqs in bat.drain().items():
+            got[k].extend(seqs)
+        if b in resize_at:
+            assert bat.resize(replace(bat.config, **resize_at[b]))
+    for k, seqs in bat.drain().items():
+        got[k].extend(seqs)
+    return got, bat
+
+
+GROW = dict(lanes=64, nodes=512, matches=256, matches_per_step=64)
+
+
+@pytest.mark.parametrize("engine", ["xla", "pallas_interpret"])
+@pytest.mark.parametrize("drain_mode", ["flat", "pool"])
+def test_resize_cycle_bitwise_golden(engine, drain_mode):
+    """grow -> shrink-back -> grow -> shrink-back across a live stream ==
+    never having resized: same matches, same emission digests, and the
+    final state + pool trees bitwise (the graft pastes compacted live
+    prefixes into init-valued pads, so grow-back is exact)."""
+    streams = {f"k{i}": letter_stream(500 + i, 24, f"k{i}") for i in range(2)}
+    kw = dict(engine=engine, drain_mode=drain_mode)
+    want, straight = drive_resized(streams, {}, **kw)
+    got, cycled = drive_resized(
+        streams,
+        {0: GROW, 2: dict(C0), 3: GROW, 4: dict(C0)},
+        **kw,
+    )
+    assert got == want
+    assert emission_digests(got) == emission_digests(want)
+    assert cycled.resizes == 4 and straight.resizes == 0
+    for c in ("lane_drops", "node_drops", "match_drops"):
+        assert cycled.stats[c] == 0 and straight.stats[c] == 0
+    assert_trees_equal(straight.state, cycled.state, "state")
+    assert_trees_equal(straight.pool, cycled.pool, "pool")
+
+
+def test_resize_mid_gc_group():
+    """A resize landing mid-group (advance index not a multiple of G)
+    flushes the group early; matches, digests and final trees must still
+    equal the G=1 straight run (cadence never changes WHAT is
+    computed)."""
+    streams = {f"k{i}": letter_stream(522 + i, 24, f"k{i}") for i in range(2)}
+    want, b1 = drive_resized(streams, {}, gc_group=1)
+    got, bg = drive_resized(streams, {1: GROW, 3: dict(C0)}, gc_group=4)
+    assert got == want
+    assert emission_digests(got) == emission_digests(want)
+    assert_trees_equal(b1.state, bg.state, "state")
+    assert_trees_equal(b1.pool, bg.pool, "pool")
+
+
+def test_resize_under_armed_event_time_gate():
+    """Resize while per-key EventTimeGates hold undelivered reordered
+    events: the gated+resized run's matches equal the gated no-resize
+    run's (the gate is host state; the resize must not perturb what the
+    engine computes from the released stream)."""
+    from kafkastreams_cep_tpu.time import EventTimeGate
+
+    keys = [f"k{i}" for i in range(2)]
+    streams = {k: letter_stream(526 + i, 24, k) for i, k in enumerate(keys)}
+    # Shuffle within a small bound so the gates genuinely reorder.
+    rng = random.Random(7)
+    shuffled = {}
+    for k, s in streams.items():
+        evs = list(s)
+        for i in range(0, len(evs) - 2, 3):
+            window = evs[i:i + 3]
+            rng.shuffle(window)
+            evs[i:i + 3] = window
+        shuffled[k] = evs
+
+    def run(resize_at):
+        gates = {
+            k: EventTimeGate(
+                capacity=64, lateness_ms=8, registry=MetricsRegistry()
+            )
+            for k in keys
+        }  # offer() holds records until the watermark clears them
+        bat = BatchedDeviceNFA(
+            compile_pattern(branching_fold_pattern()), keys=keys,
+            config=EngineConfig(**C0), engine="xla", drain_mode="flat",
+        )
+        got = {k: [] for k in keys}
+        T = 4
+        for b in range(math.ceil(24 / T)):
+            chunk = {}
+            for k in keys:
+                batch_evs = shuffled[k][b * T: (b + 1) * T]
+                if not batch_evs:
+                    continue
+                released = [e for e, _clk in gates[k].offer_batch(batch_evs)]
+                if released:
+                    chunk[k] = released
+            if chunk:
+                bat.advance_packed(bat.pack(chunk), decode=False)
+                for k, seqs in bat.drain().items():
+                    got[k].extend(seqs)
+            if b in resize_at:
+                assert bat.resize(replace(bat.config, **resize_at[b]))
+        tail = {k: [e for e, _clk in gates[k].flush()] for k in keys}
+        tail = {k: evs for k, evs in tail.items() if evs}
+        if tail:
+            bat.advance_packed(bat.pack(tail), decode=False)
+        for k, seqs in bat.drain().items():
+            got[k].extend(seqs)
+        return got
+
+    want = run({})
+    got = run({1: GROW, 3: dict(C0)})
+    assert got == want
+    assert emission_digests(got) == emission_digests(want)
+
+
+def test_shrink_refuses_when_live_state_exceeds_target():
+    """Satellite 2: a cross-shape restore that would cut live occupancy
+    raises ShapeRestoreError instead of truncating -- here, pending
+    undrained matches exceed the target pend ring."""
+    keys = ["k0"]
+    stream = [
+        Event("k0", "ACCCCD"[i % 6], TS + i, "t", 0, i) for i in range(18)
+    ]
+    bat = BatchedDeviceNFA(
+        compile_pattern(branching_fold_pattern()), keys=keys,
+        config=EngineConfig(**C0), engine="xla", drain_mode="flat",
+    )
+    # Deferred decode: the pend ring stays occupied across the advance.
+    bat.advance_packed(bat.pack({"k0": stream}), decode=False)
+    with pytest.raises(ShapeRestoreError):
+        bat.resize(replace(bat.config, matches=2))
+    # The refusal left the engine usable at its old shape.
+    assert bat.config.matches == C0["matches"]
+    assert sum(len(v) for v in bat.drain().values()) > 2
+
+
+def test_autosizer_counts_refused_shrink():
+    """The autosizer treats ShapeRestoreError as "not now": refused
+    counter up, no raise, shape unchanged."""
+    bat = BatchedDeviceNFA(
+        compile_pattern(branching_fold_pattern()), keys=["k0"],
+        config=EngineConfig(**C0), engine="xla", drain_mode="flat",
+    )
+    stream = [
+        Event("k0", "ACCCCD"[i % 6], TS + i, "t", 0, i) for i in range(18)
+    ]
+    bat.advance_packed(bat.pack({"k0": stream}), decode=False)
+    auto = CapacityAutosizer(bat)
+    auto._apply(dict(lanes=C0["lanes"], nodes=C0["nodes"], matches=2))
+    assert auto.refused == 1 and auto.resizes == 0
+    assert bat.config.matches == C0["matches"]
+    assert auto.state()["refused"] == 1
+
+
+def test_autosizer_drop_reactive_grow_couples_matches_per_step():
+    """A latched match-drop delta doubles `matches` AND
+    `matches_per_step` (the counter cannot tell the pend ring from the
+    per-(key,step) emission cap apart), and with `t` passed the ring is
+    re-grown to keep t * matches_per_step <= matches in the same move."""
+    cfg = EngineConfig(lanes=16, nodes=512, matches=8, matches_per_step=2)
+    bat = BatchedDeviceNFA(
+        compile_pattern(branching_fold_pattern()), keys=["k0"],
+        config=cfg, engine="xla", drain_mode="flat",
+    )
+    # A C C C C C D fans out one_or_more branches: far more than 2
+    # emissions in the final step and more than 8 pending -- drops latch
+    # at the drain boundary.
+    stream = [Event("k0", v, TS + i, "t", 0, i)
+              for i, v in enumerate("ACCCCCD" * 2)]
+    bat.advance({"k0": stream})
+    bat.drain()
+    assert bat.stats["match_drops"] > 0
+    auto = CapacityAutosizer(bat)
+    auto.observe(events=len(stream), t=4)
+    assert bat.config.matches_per_step == 4       # doubled
+    assert bat.config.matches >= 16               # doubled + t-coupled
+    assert bat.config.matches >= 4 * bat.config.matches_per_step
+    assert auto.resizes >= 1
+    assert auto.state()["matches_per_step"] == 4
+
+
+def test_autosizer_ensure_page_and_suggest_t():
+    """`ensure_page(t)` enforces the loss-free admission requirement
+    (t * matches_per_step <= matches, pow2); `suggest_t()` is the
+    cadence controller's advisory extent, pow2-quantized -- satellite 1
+    wires it in, so it must be live, not dead API."""
+    cfg = EngineConfig(lanes=8, nodes=256, matches=16, matches_per_step=4)
+    bat = BatchedDeviceNFA(
+        compile_pattern(branching_fold_pattern()), keys=["k0"],
+        config=cfg, engine="xla", drain_mode="flat",
+    )
+    auto = CapacityAutosizer(bat)
+    auto.ensure_page(16)
+    assert bat.config.matches >= 16 * 4
+    assert bat.config.matches & (bat.config.matches - 1) == 0  # pow2
+    t = auto.suggest_t()
+    assert auto.cadence.t_min <= t <= auto.cadence.t_max
+    assert t & (t - 1) == 0
+    assert auto.state()["suggest_t"] == t
+
+
+class _FakeEngine:
+    """Host-only stand-in for the pure control-law units: carries just
+    the surface the autosizer reads (config, metrics, occupancy bound,
+    lane_obs, resize)."""
+
+    def __init__(self, cfg):
+        self.config = cfg
+        self.metrics = MetricsRegistry()
+        self.query_name = "fake"
+        self.target_emit_ms = None
+        self.gc_group = cfg.gc_group
+        self.lane_obs = 0
+        self.occ = (0, 0, 0)  # (ring occupancy, region fill, pos)
+
+    def _occupancy_bound(self):
+        return self.occ
+
+    def resize(self, cfg):
+        changed = cfg != self.config
+        self.config = cfg
+        return changed
+
+
+def test_autosizer_proactive_grow_respects_budget_and_cooldown():
+    eng = _FakeEngine(EngineConfig(lanes=8, nodes=256, matches=64))
+    auto = CapacityAutosizer(eng, compile_budget=1, cooldown=1)
+    eng.occ = (60, 10, 0)  # ring at 94% of 64: above grow_frac
+    auto.observe()
+    assert eng.config.matches == 128 and auto.resizes == 1
+    # Budget exhausted: the next hot tick must not grow.
+    eng.occ = (125, 10, 0)
+    auto.observe()
+    assert eng.config.matches == 128 and auto.resizes == 1
+
+
+def test_autosizer_patience_shrink_floors_at_arm_config():
+    eng = _FakeEngine(EngineConfig(lanes=8, nodes=256, matches=64))
+    auto = CapacityAutosizer(
+        eng, compile_budget=8, cooldown=1, shrink_patience=3
+    )
+    # Grow once so there is something to give back.
+    eng.occ = (60, 10, 0)
+    auto.observe()
+    assert eng.config.matches == 128
+    eng.occ = (1, 1, 0)  # cold
+    for _ in range(3):
+        auto.observe()
+    assert eng.config.matches == 64  # halved after patience...
+    for _ in range(8):
+        auto.observe()
+    assert eng.config.matches == 64  # ...but never below the arm shape
+    assert eng.config.lanes == 8 and eng.config.nodes == 256
+
+
+def test_admission_pacer_pow2_pacing():
+    pacer = AdmissionPacer(target_poll_ms=100.0, min_batch=32, max_batch=8192)
+    assert pacer.suggest_batch() == 32  # no rate signal yet
+    pacer._rate_ev_s = 10_000.0  # 100 ms worth = 1000 records -> pow2 1024
+    assert pacer.suggest_batch() == 1024
+    pacer._rate_ev_s = 10_000_000.0
+    assert pacer.suggest_batch() == 8192  # clamped
+    st = pacer.state()
+    assert set(st) == {"rate_ev_s", "batch", "target_poll_ms"}
+    with pytest.raises(ValueError):
+        AdmissionPacer(target_poll_ms=0)
+
+
+def abc_pattern():
+    return (
+        QueryBuilder()
+        .select("a").where(value() == "A")
+        .then().select("b").where(value() == "B")
+        .then().select("c").where(value() == "C")
+        .build()
+    )
+
+
+def _run_topology(runtime, nkeys, **opts):
+    from kafkastreams_cep_tpu.streams.builder import ComplexStreamsBuilder
+    from kafkastreams_cep_tpu.streams.log import RecordLog
+
+    log = RecordLog()
+    b = ComplexStreamsBuilder(log=log, app_id="auto")
+    (b.stream("letters")
+      .query("q1", abc_pattern(), runtime=runtime, **opts)
+      .to("matches"))
+    topo = b.build()
+    off = 0
+    for i in range(nkeys):
+        for v in "ABCABCXABC":
+            topo.process("letters", f"k{i}", v, timestamp=1000 + off,
+                         offset=off)
+            off += 1
+    topo.flush()
+    node = topo.queries[0][1]
+    return node, sorted((r.key, r.value) for r in log.read("matches"))
+
+
+def test_auto_runtime_routes_small_stream_to_host():
+    node, out = _run_topology("auto", 4, promote_after=8)
+    st = node.processor.state()
+    assert st["runtime"] == "host"
+    assert node.processor.device is None
+    assert len(out) == 4 * 3
+
+
+def test_auto_runtime_promotes_with_identical_emissions():
+    """Crossing the key threshold promotes host -> device; the sink
+    records (key, payload) are identical to an all-device run -- the
+    promotion replay is digest-deduped, so nothing is double-emitted."""
+    cfg = EngineConfig(lanes=16, nodes=512, matches=128)
+    node_a, auto_out = _run_topology(
+        "auto", 12, promote_after=8, config=cfg
+    )
+    st = node_a.processor.state()
+    assert st["runtime"] == "tpu"
+    assert node_a.processor.autosizer is not None  # armed at promotion
+    node_t, dev_out = _run_topology("tpu", 12, batch_size=64, config=cfg)
+    assert auto_out == dev_out
+
+
+# ---------------------------------------------------------------- artifacts
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), os.pardir, "scripts")
+)
+
+
+def test_perf_ledger_excuses_autosize_flag_flip():
+    from perf_ledger import autosize_change, compare_artifacts
+
+    assert autosize_change(None, True) and autosize_change(False, True)
+    assert not autosize_change(None, None) and not autosize_change(True, True)
+    prev = {"configs": {"c": {"eps": 100.0}}, "platform": "cpu",
+            "mode": "smoke"}
+    cur = {"configs": {"c": {"eps": 50.0}}, "platform": "cpu",
+           "mode": "smoke", "autosized": True}
+    block = compare_artifacts(prev, cur)
+    assert block["regressed"] and block["excused"]
+    assert block["excuse"] == "autosize_change"
+    assert block["autosized_prev"] is None and block["autosized_cur"] is True
+    # Same flag on both sides: a real regression stays unexcused.
+    prev2 = dict(prev, autosized=True)
+    block2 = compare_artifacts(prev2, cur)
+    assert block2["regressed"] and not block2["excused"]
+    assert block2["excuse"] is None
+
+
+def test_bench_schema_validates_autosize_block_both_ways():
+    from check_bench_schema import validate as validate_bench_schema
+
+    from test_obs import _valid_artifact
+
+    art = _valid_artifact()
+    art["autosized"] = True
+    state = {
+        "lanes": 64, "nodes": 8192, "matches": 1024,
+        "matches_per_step": 16, "suggest_t": 64, "resizes": 2,
+        "refused": 0, "ticks": 5, "compile_budget": 6,
+        "floor": {"lanes": 64, "nodes": 8192, "matches": 1024},
+        "cadence": {
+            "target_emit_ms": 500.0, "gc_group": 1, "suggest_t": 64,
+            "p99_ms": None, "rate_ev_s": 100.0, "ticks": 5,
+            "adjustments": 0, "gc_changes": 0, "compile_budget": 6,
+            "compiles_seen": None,
+        },
+        "compiles_seen": None,
+    }
+    block = {
+        "state": state, "settle_rounds": 3,
+        "warmup_drops": {"lane_drops": 0, "node_drops": 0,
+                         "match_drops": 12},
+    }
+    art["autosize"] = block
+    art["configs"]["skip_any8_batched"]["autosize"] = {
+        "state": dict(state), "settle_rounds": 3,
+        "warmup_drops": dict(block["warmup_drops"]),
+    }
+    assert validate_bench_schema(art) == []
+    # Both ways: an undocumented key inside the block is an error, and a
+    # state missing its schema discriminator fields is an error.
+    bad = _valid_artifact()
+    bad["autosize"] = {"state": dict(state), "settle_rounds": 1,
+                       "warmup_drops": dict(block["warmup_drops"]),
+                       "surprise": 1}
+    assert any("surprise" in e for e in validate_bench_schema(bad))
+    bad2 = _valid_artifact()
+    s2 = dict(state)
+    del s2["matches_per_step"]
+    bad2["autosize"] = {"state": s2, "settle_rounds": 1,
+                        "warmup_drops": dict(block["warmup_drops"])}
+    assert any("matches_per_step" in e for e in validate_bench_schema(bad2))

@@ -551,6 +551,12 @@ def _valid_artifact():
             # ISSUE 17: mode-change excusal self-description.
             "mode_prev": None,
             "mode_cur": "quick",
+            # ISSUE 18: autosize excusal self-description -- the flag on
+            # both sides, plus the NAME of whichever excusal fired (None
+            # when nothing regressed or nothing excused).
+            "autosized_prev": None,
+            "autosized_cur": True,
+            "excuse": None,
         },
     }
 
