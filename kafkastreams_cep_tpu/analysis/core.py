@@ -12,6 +12,7 @@ kinds:
     static-ok(<reason>) audited jit-cache hazard (recompile)
     serde-ok(<reason>)  audited serde field exclusion (serde)
     metric-ok(<reason>) audited metric-dictionary exception (metrics)
+    trace-ok(<reason>)  audited trace-free control-plane append (tracectx)
 
 A suppression pragma without a reason is itself a finding (CEP-P01): an
 audit that does not say *why* the invariant may bend is not an audit.
@@ -62,6 +63,7 @@ SUPPRESSION_KINDS = {
     "static-ok": "recompile",
     "serde-ok": "serde",
     "metric-ok": "metrics",
+    "trace-ok": "tracectx",
 }
 #: kinds that annotate rather than suppress.
 MARKER_KINDS = ("hot-path",)
@@ -271,7 +273,9 @@ def run_checkers(
 
 
 def _load_checkers() -> Dict[str, Callable]:
-    from . import metrics_check, recompile, serde_check, threads, zerosync
+    from . import (
+        metrics_check, recompile, serde_check, threads, trace_check, zerosync,
+    )
 
     return {
         "zerosync": zerosync.check,
@@ -279,6 +283,7 @@ def _load_checkers() -> Dict[str, Callable]:
         "recompile": recompile.check,
         "serde": serde_check.check,
         "metrics": metrics_check.check,
+        "tracectx": trace_check.check,
     }
 
 

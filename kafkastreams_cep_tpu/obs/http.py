@@ -14,6 +14,12 @@ thread and serves, with zero third-party dependencies:
                  exemplars instead; ``?limit=N`` bounds either;
                  ``?format=chrome`` renders spans AND exemplars as one
                  Chrome-trace/Perfetto document (obs/trace_export.py)
+- ``/explainz``  recent emitted-match lineage (ISSUE 20): contributing
+                 event identities, run version path, trace-id exemplar,
+                 source broker, observed latency -- the read-only "why
+                 did this match fire" surface (`explain_fn`, e.g.
+                 LogDriver.explain); ``?limit=N`` / ``?query=name``
+                 bound and filter
 - ``/profilez``  ``?secs=N`` arms an on-demand device xplane capture
                  (ops.profiling.device_trace) for N seconds on a
                  background thread against the running pipeline; the
@@ -109,6 +115,7 @@ class IntrospectionServer:
         tracer: Optional[SpanTracer] = None,
         health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         match_exemplars: Optional[Callable[[int], List[Dict[str, Any]]]] = None,
+        explain_fn: Optional[Callable[[int], List[Dict[str, Any]]]] = None,
         tick_fns: Iterable[Callable[[], Any]] = (),
         tick_every_s: float = 0.25,
         host: str = "127.0.0.1",
@@ -119,6 +126,7 @@ class IntrospectionServer:
         self.tracer = tracer if tracer is not None else SpanTracer(self.registry)
         self.health_fn = health_fn
         self.match_exemplars = match_exemplars
+        self.explain_fn = explain_fn
         self.tick_fns = list(tick_fns)
         self.tick_every_s = max(0.01, float(tick_every_s))
         self._host = host
@@ -141,6 +149,7 @@ class IntrospectionServer:
             "/snapshot": self._route_snapshot,
             "/healthz": self._route_healthz,
             "/tracez": self._route_tracez,
+            "/explainz": self._route_explainz,
             "/profilez": self._route_profilez,
         }
 
@@ -284,6 +293,25 @@ class IntrospectionServer:
                 "kind": "span",
                 "spans": self.tracer.recent(limit, name=name),
             }
+        return "application/json", json.dumps(body).encode("utf-8")
+
+    def _route_explainz(self, query: Dict[str, List[str]]):
+        """Read-only match-lineage surface (ISSUE 20): the attached
+        `explain_fn`'s recent entries, newest first. ``?query=name``
+        filters to one query's matches; ``?trace_id=`` to one trace.
+        Pure ring reads -- never touches the data path."""
+        self._count_request()
+        limit = _limit(query)
+        entries: List[Dict[str, Any]] = []
+        if self.explain_fn is not None:
+            entries = self.explain_fn(limit)
+            qname = query.get("query", [None])[0]
+            if qname is not None:
+                entries = [e for e in entries if e.get("query") == qname]
+            tid = query.get("trace_id", [None])[0]
+            if tid is not None:
+                entries = [e for e in entries if e.get("trace_id") == tid]
+        body = {"kind": "explain", "matches": entries}
         return "application/json", json.dumps(body).encode("utf-8")
 
     def _route_profilez(self, query: Dict[str, List[str]]):

@@ -2565,6 +2565,13 @@ class BatchedDeviceNFA:
                 )
                 seq.provenance = prov
                 sm.sequence = seq
+                # The /explainz lineage record, built right here at the
+                # chain-flatten decode (ISSUE 20): event identities + run
+                # version path ride the SinkMatch to the topology's
+                # explain ring with no re-decode downstream.
+                from ..streams.serde import match_lineage
+
+                sm.lineage = match_lineage(seq, prov)
                 with self._prov_lock:
                     self._prov_ring.append((self.keys[k], prov))
                 self._m_prov.inc()

@@ -289,7 +289,7 @@ class EventTimeStateStore:
             return
         from ..state.serde import encode_event_time_state
 
-        self.log.append(
+        self.log.append(  # cep: trace-ok(event-time changelog snapshot: state flush, no record to trace)
             self.topic, None,
             encode_event_time_state(self.node.processor.event_time_state()),
         )
@@ -410,42 +410,148 @@ class Topology:
     #: evict (their eventual matches simply skip the latency observation).
     INGEST_STAMPS_MAX = 1 << 16
 
+    #: Bounded /explainz ring: one lineage entry per durably-admitted
+    #: match, newest kept.
+    EXPLAIN_RING = 256
+
     def __init__(self, queries: List[tuple], log: Optional[Any] = None) -> None:
         self.queries = queries
         self.log = log
         self._offsets: Dict[tuple, int] = {}
-        # (topic, partition, key, offset) -> ingest wall stamp
-        # (time.perf_counter), written by the driver at poll time, read at
-        # sink emission for the cep_match_latency_seconds{query} histogram.
+        # (topic, partition, key, offset) -> (ingest wall stamp
+        # [time.perf_counter], trace-context blob or None, broker index or
+        # None), written by the driver at poll time, read at sink emission
+        # for the cep_match_latency_seconds{query} histogram, the stitched
+        # match.emit span, and the /explainz lineage entry.
         # The full event-identity key: (key, offset) alone collides across
         # topics/partitions and would skew samples. A plain dict keeps
         # insertion order, so eviction below drops the oldest stamps.
-        self._ingest_stamps: Dict[tuple, float] = {}
+        self._ingest_stamps: Dict[tuple, tuple] = {}
+        #: Optional obs.trace.SpanTracer (attach_tracer): emitted matches
+        #: whose completing event carried wire trace context land a
+        #: "match.emit" child span here, stitching the consumer side into
+        #: the record's end-to-end trace.
+        self._tracer: Optional[Any] = None
+        from collections import deque as _deque
+
+        self._explain: Any = _deque(maxlen=self.EXPLAIN_RING)
+
+    def attach_tracer(self, tracer: Any) -> None:
+        """Attach a SpanTracer for stitched match-emission spans (the
+        driver wires its own tracer here at construction)."""
+        self._tracer = tracer
 
     def stamp_ingest(
-        self, topic: str, partition: int, key, offset: int, t: float
+        self,
+        topic: str,
+        partition: int,
+        key,
+        offset: int,
+        t: float,
+        trace: Optional[bytes] = None,
+        broker: Optional[int] = None,
     ) -> None:
-        """Record one record's ingest wall time (driver poll path)."""
+        """Record one record's ingest wall time (driver poll path), plus
+        its wire trace-context blob and source broker when known."""
         stamps = self._ingest_stamps
-        stamps[(topic, partition, key, offset)] = t
+        stamps[(topic, partition, key, offset)] = (t, trace, broker)
         # O(1) oldest-first eviction (dict preserves insertion order);
         # this runs per record on the poll path, so no list materializing.
         while len(stamps) > self.INGEST_STAMPS_MAX:
             del stamps[next(iter(stamps))]
 
     def _observe_match_latency(
-        self, node: QueryNode, topic: str, partition: int, key, offset: int
-    ) -> None:
+        self,
+        node: QueryNode,
+        topic: str,
+        partition: int,
+        key,
+        offset: int,
+        seq: Any = None,
+    ) -> Optional[bytes]:
         """Observe ingest -> emission latency for one emitted match, keyed
-        by its completing event's identity. The stamp stays: several
+        by its completing event's identity; record the /explainz lineage
+        entry; and, when the completing event carried wire trace context,
+        land the stitched "match.emit" span. Returns the trace blob (for
+        the sink append to forward) or None. The stamp stays: several
         matches may complete on one event, and replay dedup upstream
         already bounds re-observation."""
-        t0 = self._ingest_stamps.get((topic, partition, key, offset))
-        if t0 is None:
-            return  # direct process() calls / evicted stamp: no sample
+        stamp = self._ingest_stamps.get((topic, partition, key, offset))
         import time as _time
 
-        node._m_match_latency.observe(_time.perf_counter() - t0)
+        latency: Optional[float] = None
+        trace_blob: Optional[bytes] = None
+        broker: Optional[int] = None
+        ctx = None
+        if stamp is not None:
+            t0, trace_blob, broker = stamp
+            latency = _time.perf_counter() - t0
+            node._m_match_latency.observe(latency)
+            if trace_blob is not None and self._tracer is not None:
+                from ..obs.trace import TraceContext
+
+                ctx = TraceContext.decode(trace_blob)
+                if ctx is not None:
+                    self._tracer.record("match.emit", latency, trace=ctx)
+        entry: Dict[str, Any] = {
+            "query": node.name,
+            "key": str(key),
+            "topic": topic,
+            "partition": partition,
+            "offset": offset,
+            "latency_s": latency,
+            "trace_id": ctx.trace_id if ctx is not None else None,
+            "ingest_unix": ctx.ingest_unix if ctx is not None else None,
+            "broker": broker,
+        }
+        lineage = self._match_lineage(seq)
+        if lineage is not None:
+            entry.update(lineage)
+        self._explain.append(entry)
+        return trace_blob
+
+    @staticmethod
+    def _match_lineage(seq: Any) -> Optional[Dict[str, Any]]:
+        """The bounded lineage dict of one emitted match: pre-built by the
+        bytes decode (SinkMatch.lineage), derived from the attached
+        Sequence otherwise, last-event-only when neither is present."""
+        from .serde import SinkMatch, match_lineage
+
+        if seq is None:
+            return None
+        if isinstance(seq, SinkMatch):
+            if seq.lineage is not None:
+                return dict(seq.lineage)
+            if seq.sequence is not None:
+                return match_lineage(seq.sequence)
+            last = seq.last_event
+            if last is None:
+                return None
+            return {
+                "events": [
+                    {
+                        "stage": None,
+                        "topic": getattr(last, "topic", ""),
+                        "partition": getattr(last, "partition", 0),
+                        "offset": getattr(last, "offset", 0),
+                        "timestamp": getattr(last, "timestamp", 0),
+                    }
+                ],
+                "truncated_events": 0,
+                "stage_path": [],
+                "branch_depth": 0,
+                "chain_depth": 1,
+            }
+        if getattr(seq, "matched", None) is not None:
+            return match_lineage(seq)
+        return None
+
+    def explain(self, limit: int = 64) -> List[Dict[str, Any]]:
+        """Recent emitted-match lineage entries, newest first (the
+        /explainz surface): contributing event identities, run version
+        path, trace id, source broker, and the observed latency."""
+        snap = list(self._explain)
+        return snap[::-1][: max(0, limit)]
 
     @property
     def source_topics(self) -> List[str]:
@@ -520,10 +626,10 @@ class Topology:
                     for fn in node.downstream:
                         fn(key, seq)
                     if digest is not None:
-                        self._observe_match_latency(
-                            node, topic, partition, key, offset
+                        trace = self._observe_match_latency(
+                            node, topic, partition, key, offset, seq
                         )
-                        self._sink(node, record, digest)
+                        self._sink(node, record, digest, trace=trace)
         return outputs
 
     def flush(self) -> List[Record]:
@@ -601,23 +707,33 @@ class Topology:
             for fn in node.downstream:
                 fn(rkey, seq)
             if digest is not None:
+                trace: Optional[bytes] = None
                 if last is not None:
                     # Device matches complete at their last event: the
                     # ingest stamp of that event's identity anchors the
                     # end-to-end latency sample.
-                    self._observe_match_latency(
-                        node, last.topic, last.partition, rkey, last.offset
+                    trace = self._observe_match_latency(
+                        node, last.topic, last.partition, rkey, last.offset,
+                        seq,
                     )
-                self._sink(node, record, digest)
+                self._sink(node, record, digest, trace=trace)
         return emitted
 
-    def _sink(self, node: QueryNode, record: Record, digest: bytes) -> None:
+    def _sink(
+        self,
+        node: QueryNode,
+        record: Record,
+        digest: bytes,
+        trace: Optional[bytes] = None,
+    ) -> None:
         """Write a matched record to the node's sink topics in the log.
 
         The record key carries the match's emission digest
         (streams/emission.py `encode_sink_key`) so the sink topic itself
         is the durable record of what it saw -- crash recovery re-reads
-        the tail and dedupes with no cross-topic atomicity."""
+        the tail and dedupes with no cross-topic atomicity. `trace`
+        forwards the completing event's wire trace context, so a sink
+        consumer can keep stitching the same end-to-end trace."""
         if self.log is None or not node.sink_topics:
             return
         from .serde import SinkMatch, sequence_to_json
@@ -632,7 +748,8 @@ class Topology:
             value_bytes = sequence_to_json(record.value).encode("utf-8")
         for topic in node.sink_topics:
             self.log.append(
-                topic, key_bytes, value_bytes, timestamp=record.timestamp
+                topic, key_bytes, value_bytes, timestamp=record.timestamp,
+                trace=trace,
             )
 
     def event_time_health(self) -> Dict[str, Any]:

@@ -43,14 +43,36 @@ def produce(
     value: Any,
     timestamp: int = 0,
     partition: int = 0,
+    trace: bool = False,
+    tracer: Optional[SpanTracer] = None,
 ) -> int:
-    """Producer-side helper: append one (key, value) record, default serde."""
+    """Producer-side helper: append one (key, value) record, default serde.
+
+    `trace=True` mints a fresh `TraceContext` for the record (the ingest
+    end of the ISSUE 20 end-to-end trace) and rides it on the append;
+    with a `tracer` the producer's own "produce" span lands in that
+    tracer's ring as the trace's root."""
+    blob: Optional[bytes] = None
+    if trace or tracer is not None:
+        from ..obs.trace import TraceContext
+
+        ctx = TraceContext.new()
+        if tracer is not None:
+            # Root span: zero-duration marker at mint time, recorded AS
+            # the context's own span id; children (broker.append,
+            # match.emit, sink hops) parent onto it.
+            tracer.record(
+                "produce", 0.0, end_unix=ctx.ingest_unix, trace=ctx,
+                span_id=ctx.span_id, parent_id="",
+            )
+        blob = ctx.encode()
     return log.append(
         topic,
         default_serializer(key),
         default_serializer(value),
         timestamp=timestamp,
         partition=partition,
+        trace=blob,
     )
 
 
@@ -170,6 +192,10 @@ class LogDriver:
         #: Host span tracer (restore/poll/commit land in /tracez and the
         #: cep_span_seconds histogram of this driver's registry).
         self.tracer = SpanTracer(self.metrics)
+        # Stitched match-emission spans + /explainz lineage ride the same
+        # tracer (ISSUE 20).
+        if hasattr(self.topology, "attach_tracer"):
+            self.topology.attach_tracer(self.tracer)
         #: Liveness wall clocks for /healthz (None until the first event).
         self._t_started = time.time()
         self._last_poll_wall: Optional[float] = None
@@ -246,7 +272,7 @@ class LogDriver:
                 self._last_commit_wall = time.time()
                 return
             for (topic, partition), pos in dirty.items():
-                self.log.append(
+                self.log.append(  # cep: trace-ok(offset commit marker: control-plane record, no trace to carry)
                     OFFSETS_TOPIC,
                     default_serializer((self.group, topic, partition)),
                     default_serializer(pos),
@@ -317,6 +343,11 @@ class LogDriver:
             for partition in partitions:
                 start = self._positions.get((topic, partition), 0)
                 records = self.log.read(topic, partition, start, budget)
+                broker_for = getattr(self.log, "broker_for", None)
+                broker = (
+                    broker_for(topic, partition)
+                    if broker_for is not None and records else None
+                )
                 for rec in records:
                     try:
                         key = (
@@ -336,15 +367,21 @@ class LogDriver:
                             topic, partition, rec.offset,
                             rec.key, rec.value, rec.timestamp,
                             "deserialize", exc,
+                            trace=getattr(rec, "trace", None),
                         )
                         processed += 1
                         continue
                     # Ingest wall stamp (ISSUE 7): keyed by the record's
                     # full event identity, read back at sink emission to
-                    # observe cep_match_latency_seconds{query}.
+                    # observe cep_match_latency_seconds{query}. ISSUE 20:
+                    # the record's wire trace context and source broker
+                    # ride the stamp, so emission can stitch its span and
+                    # /explainz can name the hop.
                     self.topology.stamp_ingest(
                         topic, partition, key, rec.offset,
                         time.perf_counter(),
+                        trace=getattr(rec, "trace", None),
+                        broker=broker,
                     )
                     try:
                         self.topology.process(
@@ -366,6 +403,7 @@ class LogDriver:
                             topic, partition, rec.offset,
                             rec.key, rec.value, rec.timestamp,
                             "predicate", exc,
+                            trace=getattr(rec, "trace", None),
                         )
                     processed += 1
                 if records:
@@ -408,11 +446,14 @@ class LogDriver:
         timestamp: int,
         reason: str,
         exc: Exception,
+        trace: Optional[bytes] = None,
     ) -> None:
         """Quarantine one poison record to `<topic>.DLQ` (or re-raise
         under on_poison="raise"). The DLQ record keeps the original value
         bytes verbatim; the key frames provenance:
-        (tag, source topic, partition, offset, reason, original key)."""
+        (tag, source topic, partition, offset, reason, original key).
+        A wire trace context on the poison record rides to the DLQ too,
+        so even a quarantined record's story stays stitched."""
         if self.on_poison == "raise":
             raise exc
         self.log.append(
@@ -422,6 +463,7 @@ class LogDriver:
             ),
             value_bytes,
             timestamp=timestamp,
+            trace=trace,
         )
         self._m_dead_letters.labels(topic=topic, reason=reason).inc()
 
@@ -560,6 +602,13 @@ class LogDriver:
                 out.extend(fn(limit))
         return out[:limit]
 
+    def explain(self, limit: int = 64) -> list:
+        """Recent emitted-match lineage entries (the /explainz source):
+        contributing event identities, run version path, trace id, source
+        broker, observed latency -- newest first."""
+        fn = getattr(self.topology, "explain", None)
+        return fn(limit) if fn is not None else []
+
     def close(self, commit: bool = True) -> None:
         """Orderly shutdown -- the clock-thread race fix (ISSUE 9).
 
@@ -619,6 +668,7 @@ class LogDriver:
             tracer=self.tracer,
             health_fn=self.health,
             match_exemplars=self.match_exemplars,
+            explain_fn=self.explain,
             tick_fns=(self.maybe_report,),
             tick_every_s=tick_every_s,
             host=host,

@@ -34,6 +34,12 @@ class LogRecord(NamedTuple):
     timestamp: int
     key: Optional[bytes]
     value: Optional[bytes]
+    #: Opaque wire trace-context blob (obs.trace.TraceContext.encode()).
+    #: Observability only: carried in memory and over the socket transport,
+    #: NOT persisted in the file framing -- a reloaded segment yields
+    #: trace=None and every consumer must already tolerate that (decode()
+    #: returns None for absent blobs).
+    trace: Optional[bytes] = None
 
 
 def _topic_filename(topic: str, partition: int) -> str:
@@ -112,6 +118,7 @@ class RecordLog:
         value: Optional[bytes],
         timestamp: int = 0,
         partition: int = 0,
+        trace: Optional[bytes] = None,
     ) -> int:
         """Append one record; returns its offset."""
         from ..faults import injection as _flt
@@ -135,7 +142,7 @@ class RecordLog:
                 )
             records = self._records.setdefault(tp, [])
             offset = len(records)
-            records.append(LogRecord(offset, timestamp, key, value))
+            records.append(LogRecord(offset, timestamp, key, value, trace))
             if f is not None:
                 f.write(_HEADER.pack(0, timestamp))
                 _write_blob(f, key)

@@ -178,12 +178,14 @@ class PartitionedRecordLog:
         value: Optional[bytes],
         timestamp: int = 0,
         partition: int = 0,
+        trace: Optional[bytes] = None,
     ) -> int:
         broker, idx = self._routed(topic, partition)
         self._reqs_append[idx].inc()
         try:
             off = broker.append(
-                topic, key, value, timestamp=timestamp, partition=partition
+                topic, key, value, timestamp=timestamp, partition=partition,
+                trace=trace,
             )
         except Exception:
             self._errs[idx].inc()
@@ -291,6 +293,7 @@ class PartitionedRecordLog:
             dst.append(
                 topic, rec.key, rec.value,
                 timestamp=rec.timestamp, partition=partition,
+                trace=getattr(rec, "trace", None),
             )
         dst.flush()
         self.assign(topic, partition, target)

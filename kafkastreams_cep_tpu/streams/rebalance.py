@@ -32,6 +32,7 @@ against a seeded broker kill (faults/soak.py).
 """
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
@@ -289,6 +290,18 @@ def plan(
     return actions
 
 
+@contextlib.contextmanager
+def _maybe_span(tracer: Optional[Any], name: str, trace: Optional[Any]):
+    """tracer.span when a tracer is attached; a no-op yielding None
+    otherwise (migration spans are optional observability, never a
+    dependency of the handoff)."""
+    if tracer is None:
+        yield None
+    else:
+        with tracer.span(name, trace=trace) as child:
+            yield child
+
+
 class RebalanceController:
     """Executes rebalance actions: live shard migration and dead-broker
     recovery, with the `cep_rebalance_*` metric family."""
@@ -335,31 +348,45 @@ class RebalanceController:
         close_source_log: bool = True,
         registry: Optional[Any] = None,
         driver_opts: Optional[Dict[str, Any]] = None,
+        tracer: Optional[Any] = None,
+        trace: Optional[Any] = None,
     ) -> ShardPipeline:
         """Fence `source`, checkpoint it, and hand the shard to a successor
         pipeline over `make_log(sessions)` -- the caller builds the target's
         log view there, passing each broker's (session, seq) into its
         `SocketRecordLog(session=..., start_seq=...)` so server-side dedup
-        spans the move. Returns the resumed successor."""
+        spans the move. Returns the resumed successor.
+
+        With a `tracer` (and optionally a `TraceContext` in `trace`, e.g.
+        minted by the fleet controller for its decision) the three handoff
+        phases land as a stitched parent chain -- migrate.fence ->
+        migrate.checkpoint -> migrate.resume -- so the Perfetto fleet view
+        shows the migration window inside the affected records' traces."""
         t0 = time.perf_counter()
         self._m_fenced.inc()
+        ctx = trace
         try:
-            source.fence()
-            blob = source.checkpoint()
-            self._m_checkpoint_bytes.set(len(blob))
+            with _maybe_span(tracer, "migrate.fence", ctx) as child:
+                source.fence()
+                ctx = child if child is not None else ctx
+            with _maybe_span(tracer, "migrate.checkpoint", ctx) as child:
+                blob = source.checkpoint()
+                self._m_checkpoint_bytes.set(len(blob))
+                ctx = child if child is not None else ctx
             sessions = decode_shard_checkpoint(blob)["sessions"]
-            target_log = make_log(sessions)
-            source.close(close_log=close_source_log)
-            target = ShardPipeline(
-                source.shard_id,
-                build_topology or source.build_topology,
-                target_log,
-                registry=(
-                    registry if registry is not None else source.registry
-                ),
-                checkpoint=blob,
-                driver_opts=driver_opts,
-            )
+            with _maybe_span(tracer, "migrate.resume", ctx):
+                target_log = make_log(sessions)
+                source.close(close_log=close_source_log)
+                target = ShardPipeline(
+                    source.shard_id,
+                    build_topology or source.build_topology,
+                    target_log,
+                    registry=(
+                        registry if registry is not None else source.registry
+                    ),
+                    checkpoint=blob,
+                    driver_opts=driver_opts,
+                )
         finally:
             self._m_fenced.dec()
         self._m_duration.set(time.perf_counter() - t0)

@@ -144,9 +144,14 @@ class SinkMatch:
     the correctness pin), `last_event` the completing event carrying the
     Record timestamp/topic/partition/offset. `sequence` is only
     populated for provenance-sampled matches, which re-decode through
-    the object path."""
+    the object path. `lineage` (ISSUE 20) is the bounded explain record
+    for those same sampled matches -- `match_lineage()` applied at the
+    chain-flatten decode, so /explainz can answer "why did this match
+    fire" without re-materializing the Sequence."""
 
-    __slots__ = ("format", "payload", "ident", "last_event", "sequence")
+    __slots__ = (
+        "format", "payload", "ident", "last_event", "sequence", "lineage",
+    )
 
     def __init__(
         self,
@@ -155,18 +160,75 @@ class SinkMatch:
         ident: bytes,
         last_event: Any,
         sequence: Optional[Sequence] = None,
+        lineage: Optional[dict] = None,
     ) -> None:
         self.format = format
         self.payload = payload
         self.ident = ident
         self.last_event = last_event
         self.sequence = sequence
+        self.lineage = lineage
 
     def __repr__(self) -> str:
         return (
             f"SinkMatch(format={self.format!r}, "
             f"payload={len(self.payload)}B, last={self.last_event!r})"
         )
+
+
+#: Bound on contributing-event identities carried per lineage record:
+#: /explainz is a diagnostic read, not a bulk export, so a pathological
+#: thousand-event chain must not balloon the explain ring.
+LINEAGE_MAX_EVENTS = 16
+
+
+def match_lineage(
+    sequence: Sequence,
+    provenance: Optional[Any] = None,
+    max_events: int = LINEAGE_MAX_EVENTS,
+) -> dict:
+    """The bounded lineage record of one match (ISSUE 20 /explainz):
+    contributing event identities in chain order (stage, topic,
+    partition, offset, timestamp), the run's version path (stage walk +
+    Dewey branch depth, from `MatchProvenance` when sampled, re-derived
+    from the matched stages otherwise), and the chain depth. Event
+    identities past `max_events` are dropped and counted in
+    ``truncated_events``."""
+    events = []
+    total = 0
+    for staged in sequence.matched:
+        for e in staged.events:
+            total += 1
+            if len(events) < max_events:
+                events.append(
+                    {
+                        "stage": staged.stage,
+                        "topic": getattr(e, "topic", ""),
+                        "partition": getattr(e, "partition", 0),
+                        "offset": getattr(e, "offset", 0),
+                        "timestamp": getattr(e, "timestamp", 0),
+                    }
+                )
+    prov = (
+        provenance
+        if provenance is not None
+        else getattr(sequence, "provenance", None)
+    )
+    if prov is not None:
+        stage_path = list(prov.stage_path)
+        branch_depth = prov.branch_depth
+        chain_depth = prov.chain_depth
+    else:
+        stage_path = [st.stage for st in sequence.matched]
+        branch_depth = len(stage_path)
+        chain_depth = total
+    return {
+        "events": events,
+        "truncated_events": total - len(events),
+        "stage_path": stage_path,
+        "branch_depth": branch_depth,
+        "chain_depth": chain_depth,
+    }
 
 
 def sink_match_from_sequence(sequence: Sequence, format: str) -> SinkMatch:

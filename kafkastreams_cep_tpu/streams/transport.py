@@ -216,9 +216,18 @@ def _parse_i64(rd: _Reader) -> int:
 
 def _parse_records(rd: _Reader) -> List[LogRecord]:
     n = rd.u32()
-    return [
+    records = [
         LogRecord(rd.i64(), rd.i64(), rd.blob(), rd.blob()) for _ in range(n)
     ]
+    # ISSUE 20 trace propagation: servers that carry trace context append a
+    # trailing per-record blob section AFTER the classic record section, so
+    # an older client parses the same frame unchanged (it never looks past
+    # record n-1) and an older server's frame leaves traces at None here.
+    if n and rd.pos < len(rd.data):
+        records = [
+            r._replace(trace=rd.blob()) for r in records
+        ]
+    return records
 
 
 def _parse_strs(rd: _Reader) -> List[str]:
@@ -248,12 +257,18 @@ class RecordLogServer:
         io_timeout_s: float = 30.0,
         stall_inject_s: float = 0.75,
         dedup_cache: int = 4096,
+        tracer: Optional[Any] = None,
     ) -> None:
         from ..obs.registry import default_registry
 
         self.backing = backing if backing is not None else RecordLog()
         self.host = host
         self.port = port
+        #: Optional obs.trace.SpanTracer: when set, every trace-bearing
+        #: append also lands a "broker.append" child span in THIS broker's
+        #: ring, so the fleet export stitches the hop into the record's
+        #: end-to-end trace.
+        self.tracer = tracer
         self.io_timeout_s = io_timeout_s
         #: How long an injected `net.stall` freezes the apply loop. Pick
         #: it ABOVE the clients' `io_timeout_s` to force stall-detection
@@ -502,11 +517,15 @@ class RecordLogServer:
                 last = next(reversed(sess)) if sess else 0
             return ok(_U64.pack(last))
         if op == OP_APPEND:
+            t0 = time.perf_counter()
             topic = rd.str()
             part = rd.i32()
             ts = rd.i64()
             key = rd.blob()
             value = rd.blob()
+            # Optional trailing trace-context blob (ISSUE 20): absent from
+            # older clients' frames, so only read it when bytes remain.
+            trace = rd.blob() if rd.pos < len(rd.data) else None
             sid = peer["session"]
             with self._lock:
                 sess = self._sessions.get(sid) if sid is not None else None
@@ -534,7 +553,8 @@ class RecordLogServer:
                         "cannot be verified; session fenced"
                     )
                 off = self.backing.append(
-                    topic, key, value, timestamp=ts, partition=part
+                    topic, key, value, timestamp=ts, partition=part,
+                    trace=trace,
                 )
                 if sess is not None:
                     sess[seq] = off
@@ -542,6 +562,21 @@ class RecordLogServer:
                         gone, _off = sess.popitem(last=False)
                         if gone > self._evicted.get(sid, 0):
                             self._evicted[sid] = gone
+            if self.tracer is not None and trace is not None:
+                # Stitch the broker hop into the record's trace: a child
+                # span of the producer's append span. The STORED blob stays
+                # the producer's context byte-for-byte -- re-encoding per
+                # hop would make the same record read back differently from
+                # different brokers.
+                from ..obs.trace import TraceContext
+
+                ctx = TraceContext.decode(trace)
+                if ctx is not None:
+                    self.tracer.record(
+                        "broker.append",
+                        time.perf_counter() - t0,
+                        trace=ctx,
+                    )
             return ok(_I64.pack(off))
         if op == OP_READ:
             topic = rd.str()
@@ -560,6 +595,13 @@ class RecordLogServer:
                 body += _I64.pack(r.timestamp)
                 body += _pack_blob(r.key)
                 body += _pack_blob(r.value)
+            # Trailing trace section (ISSUE 20): one blob per record, after
+            # the classic section so pre-trace clients parse unchanged.
+            # Only emitted when at least one record carries context --
+            # trace-free traffic pays zero bytes.
+            if any(getattr(r, "trace", None) is not None for r in records):
+                for r in records:
+                    body += _pack_blob(r.trace)
             return ok(bytes(body))
         if op == OP_END:
             topic = rd.str()
@@ -998,6 +1040,7 @@ class SocketRecordLog:
         value: Optional[bytes],
         timestamp: int = 0,
         partition: int = 0,
+        trace: Optional[bytes] = None,
     ) -> int:
         with self._lock:
             self._check_open()
@@ -1020,6 +1063,12 @@ class SocketRecordLog:
                 + _pack_blob(key)
                 + _pack_blob(value)
             )
+            if trace is not None:
+                # Trailing optional blob: a pre-trace server never reads
+                # past `value`, so the frame stays WIRE_VERSION 1 and the
+                # context rides replays untouched (the _inflight entry
+                # keeps the sealed body, so reconnect replay re-sends it).
+                body += _pack_blob(trace)
             entry = self._submit(
                 OP_APPEND, body, _parse_i64, kind="append",
                 tp=tp, predicted=predicted,

@@ -286,9 +286,16 @@ REGRESSION_KEYS: Dict[str, tuple] = {
     # excuse, not a regression. None when a side predates the flag.
     "autosized_prev": (bool, type(None)),
     "autosized_cur": (bool, type(None)),
+    # Controller-migration excusal (ISSUE 20): a round during which the
+    # fleet controller executed rebalance actions spent wall clock on
+    # fence->checkpoint->resume by design; the marker rides both sides
+    # for auditability. None when a side predates the controller.
+    "controller_migrations_prev": (bool, type(None)),
+    "controller_migrations_cur": (bool, type(None)),
     # Which excusal actually fired (tunnel_degraded | platform_change |
-    # mode_change | autosize_change | salvaged_artifact); None when
-    # nothing regressed or nothing excused it.
+    # mode_change | autosize_change | controller_migration |
+    # salvaged_artifact); None when nothing regressed or nothing
+    # excused it.
     "excuse": (str, type(None)),
 }
 REGRESSION_METRIC_KEYS: Dict[str, tuple] = {
@@ -354,6 +361,10 @@ METRIC_KINDS = ("counter", "gauge", "histogram")
 SOAK_TOP_LEVEL: Dict[str, Tuple[bool, tuple]] = {
     "soak": (True, (dict,)),
     "scenarios": (True, (dict,)),
+    # Fleet tracing & SLO control plane (ISSUE 20): the burn-rate
+    # controller's state + stitched-trace evidence. Optional so pre-v20
+    # verdicts still validate; when present it is held to FLEET_KEYS.
+    "fleet": (False, (dict,)),
     "slos": (True, (dict,)),
     "series": (True, (dict,)),
     "metrics": (True, (dict,)),
@@ -361,6 +372,98 @@ SOAK_TOP_LEVEL: Dict[str, Tuple[bool, tuple]] = {
     "passed": (True, (bool,)),
     "schema_ok": (False, (bool,)),
 }
+
+#: The `fleet` block (ISSUE 20, ops/controller.py FleetController.state
+#: trimmed by the soak): burn/decision evidence when the controller was
+#: armed. `enabled: false` blocks carry only the trace evidence.
+FLEET_KEYS: Dict[str, tuple] = {
+    "enabled": (bool,),
+    "ticks": NUMBER,
+    "actions": NUMBER,
+    "burn": (dict,),
+    "policy": (dict,),
+    "decisions": (list,),
+    "trace": (dict,),
+}
+#: Burn SLO names -- pinned exactly (a controller that silently stops
+#: evaluating an SLO must fail the artifact's own schema).
+FLEET_BURN_KEYS: Dict[str, tuple] = {
+    "match_latency_p99": NUMBER,
+    "emission_integrity": NUMBER,
+    "pend_drift": NUMBER,
+}
+#: ControllerPolicy.as_dict() -- the thresholds the decisions were made
+#: under ride the artifact so a judge can re-derive every breach.
+FLEET_POLICY_KEYS: Dict[str, tuple] = {
+    "latency_p99_budget_s": NUMBER,
+    "drops_budget_per_s": NUMBER,
+    "pend_slope_budget_per_s": NUMBER,
+    "burn_threshold": NUMBER,
+    "skew_ratio": NUMBER,
+    "min_load": NUMBER,
+    "dead_after_s": NUMBER,
+    "cooldown_s": NUMBER,
+}
+#: One controller decision record (FleetController.tick()).
+FLEET_DECISION_KEYS: Dict[str, tuple] = {
+    "t_unix": NUMBER,
+    "scraped": (list,),
+    "shard_loads": (dict,),
+    "burn": (dict,),
+    "breached": (list,),
+    "planned": (list,),
+    "cooldown": (bool,),
+    "executed": (list,),
+}
+#: The fleet block's stitched-trace evidence: span totals and the
+#: Perfetto-loadable trace file the run wrote (None when tracing was
+#: disabled or the workdir was unwritable).
+FLEET_TRACE_KEYS: Dict[str, tuple] = {
+    "spans": NUMBER,
+    "stitched": NUMBER,
+    "trace_file": (str, type(None)),
+}
+
+
+def _check_fleet_block(
+    block: dict, where: str, errors: List[str]
+) -> None:
+    """Both-ways check of the soak's `fleet` block. A disabled block
+    carries only {enabled, trace}; an enabled one carries the full
+    controller state, with the burn names, policy knobs and decision
+    shape each pinned exactly."""
+    keys = (
+        FLEET_KEYS
+        if block.get("enabled")
+        else {k: FLEET_KEYS[k] for k in ("enabled", "trace")}
+    )
+    _check_flat_block(block, keys, where, errors)
+    if isinstance(block.get("trace"), dict):
+        _check_flat_block(
+            block["trace"], FLEET_TRACE_KEYS, f"{where}.trace", errors
+        )
+    if not block.get("enabled"):
+        return
+    if isinstance(block.get("burn"), dict):
+        _check_flat_block(
+            block["burn"], FLEET_BURN_KEYS, f"{where}.burn", errors
+        )
+    if isinstance(block.get("policy"), dict):
+        _check_flat_block(
+            block["policy"], FLEET_POLICY_KEYS, f"{where}.policy", errors
+        )
+    for i, dec in enumerate(block.get("decisions", ())):
+        if not isinstance(dec, dict):
+            errors.append(f"{where}.decisions[{i}]: expected object")
+            continue
+        _check_flat_block(
+            dec, FLEET_DECISION_KEYS, f"{where}.decisions[{i}]", errors
+        )
+        if isinstance(dec.get("burn"), dict):
+            _check_flat_block(
+                dec["burn"], FLEET_BURN_KEYS,
+                f"{where}.decisions[{i}].burn", errors,
+            )
 
 #: The `soak` run-description block.
 SOAK_RUN_KEYS: Dict[str, tuple] = {
@@ -471,6 +574,8 @@ def validate_soak(out: Any) -> List[str]:
             )
     if isinstance(out.get("soak"), dict):
         _check_flat_block(out["soak"], SOAK_RUN_KEYS, "soak", errors)
+    if isinstance(out.get("fleet"), dict):
+        _check_fleet_block(out["fleet"], "fleet", errors)
     slos = out.get("slos")
     if isinstance(slos, dict):
         for name in SOAK_SLOS:

@@ -27,7 +27,10 @@ into one trajectory table plus a regression verdict:
   deliberate size change, e.g. the r05->r06 CPU quick round), or when
   the rounds differ on the ``autosized`` flag (ISSUE 18: a hand-tuned
   round vs a zero-knob round measures deliberately different engine
-  shapes). Noise from the environment or the workload size must not
+  shapes), or when either side self-describes controller-initiated
+  shard migrations (ISSUE 20: the fleet controller's fence ->
+  checkpoint -> resume pauses are deliberate self-healing, not a code
+  regression). Noise from the environment or the workload size must not
   fail the check; such rows are reported as excused instead, with the
   excuse named.
 
@@ -261,6 +264,7 @@ def parse_artifact(doc: Any) -> Dict[str, Any]:
             "autosized": doc.get("autosized"),
             "platform": doc.get("platform"),
             "mode": artifact_mode(doc),
+            "controller_migrations": controller_migrations(doc),
             "sink_controller": ctl,
             "sink_controller_drift": drift,
             "salvaged": False,
@@ -278,6 +282,7 @@ def parse_artifact(doc: Any) -> Dict[str, Any]:
                 "autosized": parsed.get("autosized"),
                 "platform": parsed.get("platform"),
                 "mode": artifact_mode(parsed),
+                "controller_migrations": controller_migrations(parsed),
                 "sink_controller": ctl,
                 "sink_controller_drift": drift,
                 "salvaged": False,
@@ -291,11 +296,14 @@ def parse_artifact(doc: Any) -> Dict[str, Any]:
             "autosized": top.get("autosized"),
             "platform": top.get("platform"),
             "mode": top.get("mode"),
+            # A truncated tail cannot prove what the controller did.
+            "controller_migrations": None,
             "salvaged": bool(configs),
             "empty": not configs,
         }
     return {"configs": {}, "tunnel_degraded": None, "autosized": None,
-            "platform": None, "mode": None, "salvaged": False, "empty": True}
+            "platform": None, "mode": None, "controller_migrations": None,
+            "salvaged": False, "empty": True}
 
 
 def load_artifact(path: str) -> Dict[str, Any]:
@@ -396,6 +404,33 @@ def autosize_change(a: Optional[bool], b: Optional[bool]) -> bool:
     return bool(a) != bool(b) and (a is True or b is True)
 
 
+def controller_migrations(doc: Any) -> Optional[bool]:
+    """Whether a round self-describes controller-initiated shard
+    migrations (ISSUE 20): the fleet controller executed rebalance
+    actions mid-run, so part of the wall clock went to fence ->
+    checkpoint -> resume instead of throughput. Reads the explicit
+    ``controller_migrations`` marker (soak-folded pseudo-artifacts) or
+    derives it from a soak verdict's ``fleet.actions``; None when the
+    round predates the controller."""
+    if not isinstance(doc, dict):
+        return None
+    if "controller_migrations" in doc:
+        v = doc["controller_migrations"]
+        return None if v is None else bool(v)
+    fleet = doc.get("fleet")
+    if isinstance(fleet, dict):
+        return bool(fleet.get("actions"))
+    return None
+
+
+def controller_migration(a: Optional[bool], b: Optional[bool]) -> bool:
+    """Either side ran with the controller actively migrating shards: a
+    deliberate self-healing action whose pause is by design, not a code
+    regression. Only an explicit marker excuses -- rounds predating the
+    controller (None) never excuse themselves."""
+    return a is True or b is True
+
+
 def find_regressions(
     ledger: Dict[str, Any],
     rounds: List[Dict[str, Any]],
@@ -417,6 +452,7 @@ def find_regressions(
     platforms = [rec.get("platform") for rec in rounds]
     modes = [rec.get("mode") for rec in rounds]
     autosized = [rec.get("autosized") for rec in rounds]
+    ctl_migs = [rec.get("controller_migrations") for rec in rounds]
     names = [rec["round"] for rec in rounds]
     for config, series in ledger["table"].items():
         for metric in REGRESSION_METRICS:
@@ -438,6 +474,8 @@ def find_regressions(
                             excuse = "mode_change"
                         elif autosize_change(autosized[prev_i], autosized[i]):
                             excuse = "autosize_change"
+                        elif controller_migration(ctl_migs[prev_i], ctl_migs[i]):
+                            excuse = "controller_migration"
                         elif salvaged[i] or salvaged[prev_i]:
                             excuse = "salvaged_artifact"
                         out.append(
@@ -482,6 +520,8 @@ def compare_artifacts(
     mode_cur = cur["mode"] if "mode" in cur else artifact_mode(cur)
     auto_prev = prev.get("autosized")
     auto_cur = cur.get("autosized")
+    mig_prev = controller_migrations(prev)
+    mig_cur = controller_migrations(cur)
     excuse = None
     if deg_prev or deg_cur:
         excuse = "tunnel_degraded"
@@ -491,6 +531,8 @@ def compare_artifacts(
         excuse = "mode_change"
     elif autosize_change(auto_prev, auto_cur):
         excuse = "autosize_change"
+    elif controller_migration(mig_prev, mig_cur):
+        excuse = "controller_migration"
     per_config: Dict[str, Any] = {}
     regressed = False
     # A config the prior carried that the current run LACKS is reported,
@@ -541,6 +583,8 @@ def compare_artifacts(
         "mode_cur": mode_cur,
         "autosized_prev": auto_prev,
         "autosized_cur": auto_cur,
+        "controller_migrations_prev": mig_prev,
+        "controller_migrations_cur": mig_cur,
     }
 
 

@@ -1,0 +1,416 @@
+"""Fleet controller suite (ISSUE 20): SLO burn closes the rebalance loop.
+
+Pins `ops/controller.py` bottom-up -- the quantile estimator, the policy
+surface, one tick's scrape -> merge -> evaluate -> act pipeline over
+synthetic registries (skew detection, burn gauges, breach counters,
+cooldown, scrape-error isolation) -- and top-down with the acceptance
+demo: a 2-broker socket fleet where the controller, fed ONLY by scraped
+metrics, detects injected load skew, invokes `rebalance.plan()`, and
+executes a live mid-stream migration through its callback, leaving a
+stitched trace whose match-emission spans parent onto producer root
+spans ACROSS the migration boundary, with `/explainz` serving the
+lineage.
+"""
+from __future__ import annotations
+
+import json
+import random
+import time
+from urllib.request import urlopen
+
+import pytest
+
+from kafkastreams_cep_tpu import (
+    ComplexStreamsBuilder,
+    LogDriver,
+    QueryBuilder,
+    RecordLog,
+    produce,
+)
+from kafkastreams_cep_tpu.obs.registry import MetricsRegistry
+from kafkastreams_cep_tpu.obs.trace import SpanTracer
+from kafkastreams_cep_tpu.obs.trace_export import stitched_chrome_trace
+from kafkastreams_cep_tpu.ops.controller import (
+    DROP_SERIES,
+    ControllerPolicy,
+    FleetController,
+    histogram_quantile,
+)
+from kafkastreams_cep_tpu.streams.emission import decode_sink_key
+from kafkastreams_cep_tpu.streams.partition import (
+    BrokerFleet,
+    PartitionedRecordLog,
+)
+from kafkastreams_cep_tpu.streams.rebalance import (
+    RebalanceController,
+    ShardPipeline,
+)
+from kafkastreams_cep_tpu.streams.transport import SocketRecordLog
+
+pytestmark = pytest.mark.rebalance
+
+
+# ------------------------------------------------------------ policy/units
+def test_policy_defaults_round_trip():
+    pol = ControllerPolicy()
+    d = pol.as_dict()
+    assert d["latency_p99_budget_s"] == 0.5
+    assert d["drops_budget_per_s"] == 0.0
+    assert d["cooldown_s"] == 2.0
+    assert set(d) == set(ControllerPolicy.__slots__)
+    # kwargs override and coerce to float
+    assert ControllerPolicy(skew_ratio=2).as_dict()["skew_ratio"] == 2.0
+
+
+def test_drop_series_mirrors_soak():
+    """The controller's emission-integrity series set must stay equal to
+    the soak gate's (imported lazily there to avoid a faults -> ops
+    cycle; this assertion is the cycle-free guard)."""
+    from kafkastreams_cep_tpu.faults.soak import DROP_SERIES as SOAK_DROPS
+
+    assert tuple(DROP_SERIES) == tuple(SOAK_DROPS)
+
+
+def _hist_fam(entries):
+    return {"type": "histogram", "values": entries}
+
+
+def test_histogram_quantile_basic_and_edges():
+    fam = _hist_fam(
+        [
+            {
+                "count": 10,
+                "sum": 2.0,
+                "buckets": {"0.1": 8, "1.0": 9, "+Inf": 10},
+            }
+        ]
+    )
+    assert histogram_quantile(fam, 0.5) == 0.1
+    assert histogram_quantile(fam, 0.85) == 1.0
+    # Top bucket answers with the lower neighbor's finite bound.
+    assert histogram_quantile(fam, 0.99) == 1.0
+    assert histogram_quantile({"values": []}, 0.99) is None
+    # Multiple label sets sum before the quantile.
+    fam2 = _hist_fam(
+        [
+            {"count": 5, "sum": 1.0, "buckets": {"0.1": 5, "+Inf": 5}},
+            {"count": 5, "sum": 9.0, "buckets": {"0.1": 0, "+Inf": 5}},
+        ]
+    )
+    assert histogram_quantile(fam2, 0.5) == 0.1
+
+
+# ------------------------------------------------------------- tick units
+def _busy_idle_sources():
+    busy, idle = MetricsRegistry(), MetricsRegistry()
+    for reg in (busy, idle):
+        reg.counter(
+            "cep_driver_records_total", "h", labels=("group",)
+        ).labels(group="g")
+    return busy, idle
+
+
+def test_tick_detects_skew_from_scraped_deltas_and_cools_down():
+    busy, idle = _busy_idle_sources()
+    ctl_reg = MetricsRegistry()
+    executed = []
+    ctl = FleetController(
+        {"busy": busy, "idle": idle},
+        registry=ctl_reg,
+        policy=ControllerPolicy(skew_ratio=2.0, min_load=1.0,
+                                cooldown_s=60.0),
+        execute=lambda action: executed.append(action) or "ok",
+    )
+    d0 = ctl.tick()  # baseline: no deltas yet, no loads, no actions
+    assert d0["shard_loads"] == {} and d0["planned"] == []
+
+    busy._metrics["cep_driver_records_total"].labels(group="g").inc(500)
+    time.sleep(0.02)
+    d1 = ctl.tick()
+    assert d1["shard_loads"]["busy"] > 0
+    assert d1["shard_loads"]["idle"] == 0.0
+    assert [a["kind"] for a in d1["planned"]] == ["migrate"]
+    assert d1["executed"][0]["ok"] is True
+    assert executed and executed[0]["reason"] == "skew"
+
+    # Cooldown: the next breaching tick plans but does NOT execute.
+    busy._metrics["cep_driver_records_total"].labels(group="g").inc(500)
+    time.sleep(0.02)
+    d2 = ctl.tick()
+    assert d2["planned"] and d2["cooldown"] is True and d2["executed"] == []
+    assert len(executed) == 1
+
+    state = ctl.state()
+    assert state["enabled"] and state["ticks"] == 3
+    assert state["actions"] == 1
+    snap = ctl_reg.snapshot()
+    kinds = {
+        e["labels"]["kind"]: e["value"]
+        for e in snap["cep_controller_actions_total"]["values"]
+    }
+    assert kinds == {"migrate": 1.0}
+    assert snap["cep_controller_ticks_total"]["values"][0]["value"] == 3.0
+
+
+def test_tick_burn_rates_and_breach_counters():
+    busy, idle = _busy_idle_sources()
+    # Merged p99 ~10s against a 0.5s budget -> burn 20; one fleet drop
+    # against the zero budget -> full breach.
+    busy.histogram(
+        "cep_match_latency_seconds", "h", labels=("query",),
+        buckets=(0.1, 1.0, 10.0),
+    ).labels(query="q").observe(5.0)
+    busy.counter("cep_late_dropped_total", "h").inc()
+    ctl_reg = MetricsRegistry()
+    ctl = FleetController({"busy": busy, "idle": idle}, registry=ctl_reg)
+    ctl.tick()
+    time.sleep(0.02)
+    busy._metrics["cep_late_dropped_total"].inc()  # a drop BETWEEN ticks
+    d = ctl.tick()
+    assert d["burn"]["match_latency_p99"] == pytest.approx(20.0)
+    assert d["burn"]["emission_integrity"] >= 1.0
+    assert d["burn"]["pend_drift"] == 0.0
+    assert set(d["breached"]) >= {"match_latency_p99", "emission_integrity"}
+    snap = ctl_reg.snapshot()
+    burns = {
+        e["labels"]["slo"]: e["value"]
+        for e in snap["cep_slo_burn_rate"]["values"]
+    }
+    assert set(burns) == {
+        "match_latency_p99", "emission_integrity", "pend_drift"
+    }
+    breaches = {
+        e["labels"]["slo"]: e["value"]
+        for e in snap["cep_slo_burn_breaches_total"]["values"]
+    }
+    assert breaches["match_latency_p99"] >= 1.0
+
+
+def test_scrape_error_isolated_and_counted():
+    """A dead source is counted and skipped; the tick proceeds on the
+    rest -- the loop never wedges on one dead broker."""
+    busy, _ = _busy_idle_sources()
+
+    def dead():
+        raise ConnectionError("down")
+
+    ctl_reg = MetricsRegistry()
+    ctl = FleetController({"ok": busy, "dead": dead}, registry=ctl_reg)
+    d = ctl.tick()
+    assert d["scraped"] == ["ok"]
+    errs = {
+        e["labels"]["device"]: e["value"]
+        for e in ctl_reg.snapshot()[
+            "cep_controller_scrape_errors_total"
+        ]["values"]
+    }
+    assert errs == {"dead": 1.0}
+
+
+def test_controller_requires_sources_and_bounds_decisions():
+    with pytest.raises(ValueError):
+        FleetController({})
+    busy, idle = _busy_idle_sources()
+    ctl = FleetController(
+        {"b": busy, "i": idle}, registry=MetricsRegistry(), decisions=4
+    )
+    for _ in range(7):
+        ctl.tick()
+    assert len(ctl.state()["decisions"]) == 4
+    assert ctl.state()["ticks"] == 7
+    newest_first = ctl.decisions(limit=2)
+    assert len(newest_first) == 2
+    assert newest_first[0]["t_unix"] >= newest_first[1]["t_unix"]
+
+
+def test_controller_daemon_lifecycle():
+    busy, idle = _busy_idle_sources()
+    with FleetController(
+        {"b": busy, "i": idle}, registry=MetricsRegistry(), every_s=0.02
+    ) as ctl:
+        deadline = time.time() + 5.0
+        while ctl.state()["ticks"] < 3 and time.time() < deadline:
+            time.sleep(0.02)
+    ticks = ctl.state()["ticks"]
+    assert ticks >= 3
+    time.sleep(0.08)
+    assert ctl.state()["ticks"] == ticks, "stop() must halt the loop"
+
+
+# ------------------------------------------------------------- acceptance
+def _pattern():
+    return (
+        QueryBuilder()
+        .select("select-A").where(lambda e, s: e.value == "A")
+        .then().select("select-B").where(lambda e, s: e.value == "B")
+        .then().select("select-C").where(lambda e, s: e.value == "C")
+        .build()
+    )
+
+
+def _topology(log, shard_id, registry):
+    builder = ComplexStreamsBuilder(log=log, app_id=f"ctl-{shard_id}")
+    (
+        builder.stream("letters")
+        .query("q", _pattern(), runtime="host", registry=registry)
+        .to("matches")
+    )
+    return builder.build()
+
+
+def _fleet_view(fleet, reg, sessions=None, assignment=None):
+    clients = []
+    for i, server in enumerate(fleet.servers):
+        kw = {}
+        sess = (sessions or {}).get(str(i))
+        if sess is not None:
+            kw.update(session=sess[0], start_seq=sess[1])
+        clients.append(SocketRecordLog(server.address, registry=reg, **kw))
+    return PartitionedRecordLog(clients, registry=reg, assignment=assignment)
+
+
+def _stream(seed, n=36):
+    rng = random.Random(seed)
+    out = []
+    while len(out) < n:
+        out.extend(rng.choice(("ABC", "ABC", "AB", "BC", "X", "AXC")))
+    return out[:n]
+
+
+def test_fleet_controller_acceptance_two_brokers(tmp_path):
+    """The ISSUE 20 acceptance demo: 2 socket brokers, traced records, a
+    busy and an idle shard registry scraped by the controller -- which
+    detects the skew from `cep_driver_records_total` deltas alone,
+    plans, and executes a LIVE mid-stream migration via its callback.
+    The surviving pipeline finishes the stream exactly-once; the
+    stitched trace shows match.emit spans parented on producer root
+    spans across the migration boundary; /explainz serves the lineage
+    with trace-id exemplars."""
+    events = _stream(11, n=36)
+    reg = MetricsRegistry()        # busy shard: fleet + pipeline + producer
+    idle_reg = MetricsRegistry()   # idle shard: scraped, never loaded
+    idle_reg.counter(
+        "cep_driver_records_total", "h", labels=("group",)
+    ).labels(group="idle")
+    prod_tracer = SpanTracer(MetricsRegistry())
+    broker_tracer = SpanTracer(MetricsRegistry())
+    fleet = BrokerFleet(
+        str(tmp_path), n_brokers=2, registry=reg, tracer=broker_tracer
+    )
+    tgt = None
+    http = None
+    try:
+        src_log = _fleet_view(fleet, reg)
+        for i, ch in enumerate(events):
+            produce(src_log, "letters", "K", ch, timestamp=i,
+                    trace=True, tracer=prod_tracer)
+        src_log.flush()
+
+        def bt(lg, sid):
+            return _topology(lg, sid, registry=reg)
+
+        src = ShardPipeline("s0", bt, src_log,
+                            partitions={"letters": (0,)}, registry=reg)
+        reb = RebalanceController(registry=reg)
+        migrated = []
+
+        def execute(action):
+            assert action["kind"] == "migrate" and action["shard"] == "s0"
+            successor = reb.migrate(
+                src,
+                lambda sessions: _fleet_view(
+                    fleet, reg, sessions=sessions,
+                    assignment=src_log.assignment(),
+                ),
+                reason=str(action["reason"]),
+            )
+            migrated.append(successor)
+            return "migrated"
+
+        ctl = FleetController(
+            {"s0": reg, "idle": idle_reg},
+            registry=MetricsRegistry(),
+            policy=ControllerPolicy(
+                skew_ratio=2.0, min_load=1.0, cooldown_s=60.0,
+                latency_p99_budget_s=60.0,
+            ),
+            execute=execute,
+        )
+        ctl.tick()  # baseline scrape: seeds the per-device deltas
+        for _ in range(3):  # a strict prefix lands on the busy shard
+            src.poll(max_records=4)
+        time.sleep(0.02)
+        decision = ctl.tick()  # sees the records/s skew, migrates LIVE
+
+        assert decision["shard_loads"]["s0"] > 0
+        assert [a["kind"] for a in decision["planned"]] == ["migrate"]
+        assert decision["executed"][0]["ok"] is True
+        assert decision["executed"][0]["result"] == "migrated"
+        assert migrated and src.fenced
+        tgt = migrated[0]
+
+        while tgt.poll(max_records=4):
+            pass
+        tgt.driver.commit()
+
+        # Exactly-once across the controller-driven migration.
+        digests = []
+        for rec in tgt.log.read("matches"):
+            _key, digest = decode_sink_key(rec.key)
+            digests.append(digest)
+        assert digests and len(set(digests)) == len(digests)
+        assert (
+            reg._metrics["cep_driver_records_total"]
+            .labels(group="shard-s0").value == len(events)
+        )
+        assert (
+            reg._metrics["cep_rebalance_migrations_total"]
+            .labels(reason="skew").value == 1
+        )
+
+        # Cross-migration stitched parentage: a match emitted by the
+        # SUCCESSOR parents onto the producer's root span.
+        roots = {
+            s["span_id"]: s["trace_id"]
+            for s in prod_tracer.recent(512, name="produce")
+        }
+        emits = tgt.driver.tracer.recent(512, name="match.emit")
+        assert emits, "successor must emit traced matches post-migration"
+        stitched_pairs = [
+            s for s in emits
+            if roots.get(s["parent_id"]) == s["trace_id"]
+        ]
+        assert stitched_pairs, "match.emit must parent on a producer root"
+        hops = broker_tracer.recent(1024, name="broker.append")
+        assert hops and all(
+            h["parent_id"] in roots for h in hops
+        ), "broker hops parent on producer roots too"
+        doc = stitched_chrome_trace(
+            prod_tracer, broker_tracer, tgt.driver.tracer,
+            names=["producer", "brokers", "successor"],
+        )
+        flow = [e for e in doc["traceEvents"] if e.get("name") == "propagate"]
+        assert flow, "stitched export must draw cross-process arrows"
+
+        # /explainz over live HTTP: lineage with trace-id exemplars.
+        http = tgt.driver.serve_http(port=0)
+        with urlopen(http.url + "/explainz?limit=64", timeout=5) as resp:
+            body = json.loads(resp.read().decode("utf-8"))
+        assert body["kind"] == "explain" and body["matches"]
+        entry = body["matches"][0]
+        assert entry["query"] == "q" and entry["trace_id"]
+        assert entry["events"], "lineage must name contributing events"
+        with urlopen(
+            http.url + f"/explainz?trace_id={entry['trace_id']}", timeout=5
+        ) as resp:
+            one = json.loads(resp.read().decode("utf-8"))["matches"]
+        assert one and all(e["trace_id"] == entry["trace_id"] for e in one)
+
+        # The controller's own artifact block records the story.
+        state = ctl.state()
+        assert state["actions"] == 1
+        assert state["decisions"][-1]["executed"][0]["result"] == "migrated"
+    finally:
+        if tgt is not None:
+            tgt.close(close_log=True)
+        fleet.stop()

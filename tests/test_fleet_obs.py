@@ -1,0 +1,261 @@
+"""Fleet tracing suite (ISSUE 20): wire trace-context propagation.
+
+Pins the v20 observability contract end to end: the 25-byte
+`TraceContext` codec (golden bytes -- the blob is a wire format), the
+optional trailing blob on append/read frames (pre-trace peers parse
+unchanged, trace-free traffic pays zero bytes), blob survival across a
+broker crash-restart replay, cross-process span stitching through
+`stitched_chrome_trace`, and the obs/merge edge rules the fleet
+controller leans on (gauge device-collision errors, bounded merged
+cardinality).
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import pytest
+
+from kafkastreams_cep_tpu.obs.merge import merge_registries, merge_snapshots
+from kafkastreams_cep_tpu.obs.registry import MetricsRegistry
+from kafkastreams_cep_tpu.obs.trace import TRACE_CTX_VERSION, SpanTracer, TraceContext
+from kafkastreams_cep_tpu.obs.trace_export import stitched_chrome_trace
+from kafkastreams_cep_tpu.streams.log import RecordLog
+from kafkastreams_cep_tpu.streams.transport import (
+    RecordLogServer,
+    SocketRecordLog,
+)
+
+pytestmark = pytest.mark.obs
+
+
+# ------------------------------------------------------------------ codec
+def test_trace_context_codec_golden():
+    """The blob is a wire format: 25 bytes, exact layout, stable."""
+    ctx = TraceContext("0123456789abcdef", "fedcba9876543210", 12.5)
+    blob = ctx.encode()
+    assert len(blob) == 25
+    assert blob == struct.pack(
+        "<B8s8sd",
+        TRACE_CTX_VERSION,
+        bytes.fromhex("0123456789abcdef"),
+        bytes.fromhex("fedcba9876543210"),
+        12.5,
+    )
+    back = TraceContext.decode(blob)
+    assert back == ctx
+    assert back.as_dict() == {
+        "trace_id": "0123456789abcdef",
+        "span_id": "fedcba9876543210",
+        "ingest_unix": 12.5,
+    }
+
+
+def test_trace_context_decode_tolerates_garbage():
+    """Trace context is observability, never a reason to reject a
+    record: absent, truncated, oversized and unknown-version blobs all
+    decode to None."""
+    good = TraceContext.new(1.0).encode()
+    assert TraceContext.decode(None) is None
+    assert TraceContext.decode(b"") is None
+    assert TraceContext.decode(good[:-1]) is None
+    assert TraceContext.decode(good + b"\x00") is None
+    future = bytes([TRACE_CTX_VERSION + 1]) + good[1:]
+    assert TraceContext.decode(future) is None
+    assert TraceContext.decode(good) is not None
+
+
+def test_trace_context_child_keeps_trace_swaps_parent():
+    root = TraceContext.new(3.0)
+    child = root.child("00000000000000aa")
+    assert child.trace_id == root.trace_id
+    assert child.ingest_unix == root.ingest_unix
+    assert child.span_id == "00000000000000aa"
+    assert child != root
+
+
+# ----------------------------------------------------------- wire framing
+def test_wire_roundtrip_trace_blob():
+    """The blob rides the append frame and comes back on read -- and only
+    traced records carry one (mixed topics read correctly)."""
+    srv = RecordLogServer().start()
+    cli = SocketRecordLog(srv.address)
+    try:
+        ctx = TraceContext.new(7.0)
+        cli.append("t", b"k0", b"v0", trace=ctx.encode())
+        cli.append("t", b"k1", b"v1")  # untraced in the same topic
+        recs = cli.read("t")
+        assert [r.value for r in recs] == [b"v0", b"v1"]
+        assert TraceContext.decode(recs[0].trace) == ctx
+        assert recs[1].trace is None
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_wire_untraced_topic_has_no_trace_section():
+    """Trace-free traffic pays zero bytes: a read of a topic with no
+    traced records returns frames with every trace None (the trailing
+    per-record section is only emitted when >= 1 record carries one)."""
+    srv = RecordLogServer().start()
+    cli = SocketRecordLog(srv.address)
+    try:
+        for i in range(4):
+            cli.append("plain", b"k", b"v%d" % i)
+        recs = cli.read("plain")
+        assert len(recs) == 4
+        assert all(r.trace is None for r in recs)
+    finally:
+        cli.close()
+        srv.stop()
+
+
+@pytest.mark.chaos
+def test_trace_blob_survives_crash_restart_replay(tmp_path):
+    """A broker-side torn append kills the 'broker'; the client's replay
+    re-sends the SEALED frame body, so the replayed record keeps its
+    trace blob bit-identical across the restart."""
+    from kafkastreams_cep_tpu.faults.injection import (
+        FaultInjector,
+        FaultPoint,
+        FaultSchedule,
+        armed,
+    )
+
+    srv = RecordLogServer(RecordLog(str(tmp_path / "broker"))).start()
+    cli = None
+    try:
+        ctxs = [TraceContext.new(float(i)) for i in range(6)]
+        schedule = FaultSchedule([FaultPoint("log.torn_append", 3)])
+        with armed(FaultInjector(schedule)):
+            cli = SocketRecordLog(srv.address, io_timeout_s=2.0)
+            for i, ctx in enumerate(ctxs):
+                assert cli.append(
+                    "t", b"k", b"v%d" % i, trace=ctx.encode()
+                ) == i
+        recs = cli.read("t")
+        assert [r.value for r in recs] == [b"v%d" % i for i in range(6)]
+        for i, (rec, ctx) in enumerate(zip(recs, ctxs)):
+            if i < 2:
+                # Pre-crash records reload from the file frames, and the
+                # blob is wire/memory-only by design -- gone, not wrong.
+                assert rec.trace is None
+            else:
+                # The torn (replayed) append and everything after it
+                # carry their blobs: replay re-sends the sealed body.
+                assert TraceContext.decode(rec.trace) == ctx
+        assert srv.health()["restarts"] == 1
+    finally:
+        if cli is not None:
+            cli.close()
+        srv.stop()
+
+
+# -------------------------------------------------------------- stitching
+def test_stitched_chrome_trace_cross_process_parentage():
+    """Spans landed by different processes stitch by trace id: the
+    stitched view gets its own pid row, every tracer keeps a wall-clock
+    row, and flow arrows cross the process boundary."""
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    producer, broker = SpanTracer(reg_a), SpanTracer(reg_b)
+
+    ctx = TraceContext.new(10.0)
+    # Producer root: recorded AS the context's own span id (no parent).
+    producer.record(
+        "produce", 0.0, end_unix=ctx.ingest_unix, trace=ctx,
+        span_id=ctx.span_id, parent_id="",
+    )
+    # Broker hop in ANOTHER tracer: a child onto the wire context.
+    broker.record("broker.append", 0.002, end_unix=10.5, trace=ctx)
+
+    doc = stitched_chrome_trace(producer, broker, names=["prod", "brk"])
+    names = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e.get("name") == "process_name"
+    }
+    assert names == {
+        "prod (wall clock)", "brk (wall clock)", "stitched traces (fleet)"
+    }
+    flows = [
+        e for e in doc["traceEvents"]
+        if e.get("name") == "propagate" and e.get("ph") in ("s", "f")
+    ]
+    assert len(flows) >= 2, "expected a cross-process flow arrow pair"
+    stitched = [
+        e for e in doc["traceEvents"]
+        if e.get("ph") == "b" and e.get("id") == ctx.trace_id
+    ]
+    assert stitched, "stitched async track must be keyed by trace id"
+    json.dumps(doc)  # the export must be JSON-serializable as-is
+
+
+def test_stitched_chrome_trace_distinct_pids():
+    """Per-tracer pids never collide with the stitched row, whatever the
+    tracer count."""
+    tracers = [SpanTracer(MetricsRegistry()) for _ in range(4)]
+    ctx = TraceContext.new(1.0)
+    for i, tr in enumerate(tracers):
+        tr.record(f"hop{i}", 0.001, end_unix=1.0 + i, trace=ctx)
+    doc = stitched_chrome_trace(*tracers)
+    pids = {
+        e["pid"] for e in doc["traceEvents"] if e.get("name") == "process_name"
+    }
+    assert len(pids) == len(tracers) + 1
+
+
+# ----------------------------------------------------------- merge edges
+def _gauge_snap(value, device_label=None):
+    reg = MetricsRegistry()
+    if device_label is None:
+        reg.gauge("cep_pend_occupancy", "h").set(value)
+    else:
+        reg.gauge("cep_pend_occupancy", "h", labels=("device",)).labels(
+            device=device_label
+        ).set(value)
+    return reg.snapshot()
+
+
+def test_merge_gauge_device_label_collision_raises():
+    """Two source registries claiming one device label value is an
+    error, never a silent overwrite."""
+    snaps = {
+        "dev0": _gauge_snap(1.0, device_label="dev1"),
+        "dev1": _gauge_snap(2.0, device_label="dev1"),
+    }
+    with pytest.raises(ValueError, match="two devices claim"):
+        merge_snapshots(snaps)
+
+
+def test_merge_gauge_devices_stay_distinct():
+    merged = merge_snapshots(
+        {"dev0": _gauge_snap(1.0), "dev1": _gauge_snap(2.0)}
+    )
+    fam = merged["cep_pend_occupancy"]
+    assert fam["label_names"] == ["device"]
+    by_dev = {e["labels"]["device"]: e["value"] for e in fam["values"]}
+    assert by_dev == {"dev0": 1.0, "dev1": 2.0}
+
+
+def test_merge_registries_bounded_cardinality():
+    """A fleet-wide label explosion fails loudly at the merge, not at
+    the scraper: `max_label_sets` clamps the rebuilt registry."""
+    regs = {}
+    for d in range(4):
+        reg = MetricsRegistry()
+        reg.gauge("cep_pend_occupancy", "h").set(float(d))
+        regs[f"dev{d}"] = reg
+    merged = merge_registries(regs, max_label_sets=8)
+    assert len(merged.snapshot()["cep_pend_occupancy"]["values"]) == 4
+    with pytest.raises(ValueError, match="cardinality"):
+        merge_registries(regs, max_label_sets=2)
+
+
+def test_merge_histogram_layout_mismatch_raises():
+    """One family, one bucket layout -- a device disagreeing is two
+    subsystems fighting over one name."""
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.histogram("cep_h_seconds", "h", buckets=(0.1, 1.0)).observe(0.05)
+    b.histogram("cep_h_seconds", "h", buckets=(0.5, 2.0)).observe(0.05)
+    with pytest.raises(ValueError, match="bucket layout"):
+        merge_snapshots({"dev0": a.snapshot(), "dev1": b.snapshot()})

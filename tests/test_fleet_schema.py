@@ -1,0 +1,175 @@
+"""Fleet artifact surface (ISSUE 20): schema pins + ledger excusal.
+
+The soak's `fleet` block and the `controller_migrations` regression
+markers are machine-checked contracts: this suite drives
+`check_bench_schema._check_fleet_block` both ways (a real controller's
+`state()` validates; drift in burn names, policy knobs, or decision
+shape fails) and `perf_ledger`'s controller-migration excuse class
+(explicit markers excuse, rounds predating the controller never do).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts")
+)
+from check_bench_schema import _check_fleet_block, validate_soak  # noqa: E402
+from perf_ledger import (  # noqa: E402
+    compare_artifacts,
+    controller_migration,
+    controller_migrations,
+    find_regressions,
+)
+
+from kafkastreams_cep_tpu.obs.registry import MetricsRegistry
+from kafkastreams_cep_tpu.ops.controller import FleetController
+
+pytestmark = pytest.mark.soak
+
+
+def _live_fleet_block(ticks=2):
+    """A real controller's state() + the trace sub-block the soak adds."""
+    reg = MetricsRegistry()
+    reg.counter("cep_driver_records_total", "h", labels=("group",)).labels(
+        group="g"
+    )
+    ctl = FleetController({"dev0": reg}, registry=MetricsRegistry())
+    for _ in range(ticks):
+        ctl.tick()
+    block = ctl.state()
+    block["trace"] = {"spans": 0, "stitched": 0, "trace_file": None}
+    return block
+
+
+# ------------------------------------------------------------ fleet schema
+def test_live_controller_state_validates():
+    errors: list = []
+    _check_fleet_block(_live_fleet_block(), "fleet", errors)
+    assert errors == []
+
+
+def test_disabled_fleet_block_is_minimal():
+    errors: list = []
+    _check_fleet_block(
+        {"enabled": False,
+         "trace": {"spans": 3, "stitched": 1, "trace_file": "TRACE.json"}},
+        "fleet", errors,
+    )
+    assert errors == []
+    # A disabled block smuggling controller keys is undocumented noise.
+    errors = []
+    _check_fleet_block(
+        {"enabled": False, "ticks": 5,
+         "trace": {"spans": 0, "stitched": 0, "trace_file": None}},
+        "fleet", errors,
+    )
+    assert any("ticks" in e for e in errors)
+
+
+@pytest.mark.parametrize(
+    "mutate, needle",
+    [
+        (lambda b: b["burn"].pop("pend_drift"), "pend_drift"),
+        (lambda b: b["burn"].update(novel_slo=1.0), "novel_slo"),
+        (lambda b: b["policy"].pop("cooldown_s"), "cooldown_s"),
+        (lambda b: b["decisions"][-1].pop("breached"), "breached"),
+        (lambda b: b["decisions"][-1].update(surprise=1), "surprise"),
+        (lambda b: b["trace"].update(trace_file=7), "trace_file"),
+        (lambda b: b.pop("actions"), "actions"),
+    ],
+)
+def test_fleet_block_drift_fails_schema(mutate, needle):
+    """Both ways: every documented key required, nothing undocumented --
+    a controller that silently stops evaluating an SLO (or grows an
+    unpinned field) fails its own artifact."""
+    block = _live_fleet_block()
+    mutate(block)
+    errors: list = []
+    _check_fleet_block(block, "fleet", errors)
+    assert any(needle in e for e in errors), errors
+
+
+def test_validate_soak_tolerates_pre_v20_artifacts():
+    """`fleet` is optional at the top level: a pre-v20 verdict without
+    the block must not fail, and a present block must be checked."""
+    doc = {"passed": True, "slos": {}}
+    errs = validate_soak(doc)
+    assert not any("fleet" in e for e in errs)
+    doc["fleet"] = {"enabled": False, "trace": {"spans": 0}}
+    errs = validate_soak(doc)
+    assert any("fleet.trace" in e for e in errs)  # missing stitched/file
+
+
+# --------------------------------------------------------- ledger excusal
+def test_controller_migrations_marker_and_derivation():
+    assert controller_migrations({"controller_migrations": True}) is True
+    assert controller_migrations({"controller_migrations": False}) is False
+    assert controller_migrations({"controller_migrations": None}) is None
+    # Derived from a soak verdict's fleet block.
+    assert controller_migrations({"fleet": {"actions": 2}}) is True
+    assert controller_migrations({"fleet": {"actions": 0}}) is False
+    # Predates the controller entirely: unknown, never an excuse.
+    assert controller_migrations({"passed": True}) is None
+    assert controller_migration(None, None) is False
+    assert controller_migration(True, None) is True
+    assert controller_migration(False, False) is False
+
+
+def _round_doc(eps, **extra):
+    doc = {"configs": {"flagship": {"events": 1000, "seconds": 1.0,
+                                    "eps": eps}}}
+    doc.update(extra)
+    return doc
+
+
+def test_compare_artifacts_controller_migration_excuses():
+    """A >= 15% eps drop on a round whose controller actively migrated
+    shards is excused as controller_migration -- and the markers ride
+    the block for audit."""
+    prev = _round_doc(100_000.0)
+    cur = _round_doc(70_000.0, controller_migrations=True)
+    block = compare_artifacts(prev, cur)
+    assert block["regressed"] is True
+    assert block["excused"] is True
+    assert block["excuse"] == "controller_migration"
+    assert block["controller_migrations_prev"] is None
+    assert block["controller_migrations_cur"] is True
+
+
+def test_compare_artifacts_unknown_side_never_excuses():
+    prev = _round_doc(100_000.0)
+    cur = _round_doc(70_000.0)  # both predate the controller: no excuse
+    block = compare_artifacts(prev, cur)
+    assert block["regressed"] is True and block["excused"] is False
+    assert block["excuse"] is None
+    assert block["controller_migrations_prev"] is None
+    assert block["controller_migrations_cur"] is None
+
+
+def test_find_regressions_controller_migration_in_chain():
+    """The ledger's excuse chain names controller_migration for a drop
+    into (or out of) a migrating round, and an explicit False keeps the
+    regression un-excused."""
+    from perf_ledger import build_ledger, parse_artifact
+
+    def rec(name, eps, **extra):
+        r = parse_artifact(_round_doc(eps, **extra))
+        r["round"] = name
+        return r
+
+    rounds = [
+        rec("r1", 100_000.0, controller_migrations=False),
+        rec("r2", 60_000.0, controller_migrations=True),
+        rec("r3", 30_000.0, controller_migrations=False),
+    ]
+    regs = find_regressions(build_ledger(rounds), rounds)
+    by_round = {r["round"]: r for r in regs}
+    assert by_round["r2"]["excuse"] == "controller_migration"
+    assert by_round["r2"]["excused"] is True
+    # r3 dropped vs r2, and r2 was migrating: still the migration's
+    # excuse window (either side True excuses).
+    assert by_round["r3"]["excuse"] == "controller_migration"

@@ -372,8 +372,8 @@ def test_ingest_stamps_full_identity_and_bounded_eviction():
     topo = _letters_pipeline("host", MetricsRegistry(), log)
     topo.stamp_ingest("a", 0, "K", 5, 100.0)
     topo.stamp_ingest("b", 0, "K", 5, 200.0)  # same (key, offset), other topic
-    assert topo._ingest_stamps[("a", 0, "K", 5)] == 100.0
-    assert topo._ingest_stamps[("b", 0, "K", 5)] == 200.0
+    assert topo._ingest_stamps[("a", 0, "K", 5)] == (100.0, None, None)
+    assert topo._ingest_stamps[("b", 0, "K", 5)] == (200.0, None, None)
     topo.INGEST_STAMPS_MAX = 3  # instance override for the bound
     for i in range(6):
         topo.stamp_ingest("a", 0, "K", 100 + i, float(i))

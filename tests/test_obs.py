@@ -556,6 +556,10 @@ def _valid_artifact():
             # when nothing regressed or nothing excused).
             "autosized_prev": None,
             "autosized_cur": True,
+            # ISSUE 20: controller-migration excusal self-description
+            # (None when the side predates the fleet controller).
+            "controller_migrations_prev": None,
+            "controller_migrations_cur": None,
             "excuse": None,
         },
     }

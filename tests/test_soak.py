@@ -293,7 +293,9 @@ def test_soak_regression_slo_against_prior_artifact(quick_soak, tmp_path):
     """eps_regression reuses perf_ledger.compare_artifacts verbatim: a
     fabricated fast prior flags, the soak's own prior does not. Both
     comparisons are quick-vs-quick (the prior IS the quick run's doc),
-    so the ledger's mode-change excusal must stay out of the way."""
+    so the ledger's mode-change, autosize and controller-migration
+    excusals must all stay out of the way: the current side mirrors the
+    doc's own self-description flags, same as soak.main does."""
     _rc, doc = quick_soak
     fast_prior = tmp_path / "SOAK_fast.json"
     boosted = json.loads(json.dumps(doc))
@@ -309,8 +311,11 @@ def test_soak_regression_slo_against_prior_artifact(quick_soak, tmp_path):
         platform=doc["soak"]["platform"],
         tolerance=0.15,
         quick=True,
+        autosized=doc["soak"]["autosized"],
+        controller_migrations=bool(doc["fleet"]["actions"]),
     )
     assert block["regressed"] is True and block["excused"] is False
+    assert block["excuse"] is None
     same_prior = tmp_path / "SOAK_same.json"
     same_prior.write_text(json.dumps(doc))
     block2 = soak._eps_regression_block(
@@ -322,6 +327,8 @@ def test_soak_regression_slo_against_prior_artifact(quick_soak, tmp_path):
         platform=doc["soak"]["platform"],
         tolerance=0.15,
         quick=True,
+        autosized=doc["soak"]["autosized"],
+        controller_migrations=bool(doc["fleet"]["actions"]),
     )
     assert block2["regressed"] is False
 
